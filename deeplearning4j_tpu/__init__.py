@@ -1,0 +1,27 @@
+"""tpu-dl4j: a TPU-native deep-learning framework with DeepLearning4j's capabilities.
+
+A ground-up JAX/XLA/Pallas re-design of the DL4J framework layer (reference:
+dawncc/deeplearning4j). Where DL4J hand-writes per-layer forward/backward over ND4J
+kernels, this framework expresses layers as pure functions over pytrees, differentiates
+with `jax.grad`, compiles whole training steps with `jax.jit`, and scales out with a
+single sharded step over a `jax.sharding.Mesh` (replacing ParallelWrapper thread
+averaging, Spark parameter averaging, and the Aeron parameter server).
+
+Package map (mirrors the reference's module inventory, SURVEY.md section 2):
+
+- ``ops``       -- tensor op facade (activations, losses, conv, rng) over jax.numpy/lax
+- ``nn``        -- config system, layers, MultiLayerNetwork, ComputationGraph, updaters
+- ``optimize``  -- listeners, solvers, gradient accumulation
+- ``eval``      -- Evaluation / RegressionEvaluation / ROC
+- ``datasets``  -- DataSet / iterators / built-in datasets
+- ``parallel``  -- mesh trainer (DP/TP/SP), ParallelWrapper/ParallelInference parity
+- ``models``    -- model zoo (LeNet ... ResNet50, VGG16)
+- ``nlp``       -- SequenceVectors / Word2Vec / ParagraphVectors / GloVe
+- ``graph_emb`` -- graph embeddings (DeepWalk, random walks)
+- ``modelimport`` -- Keras h5 import
+- ``ui``        -- stats listeners / storage / web UI
+- ``earlystopping`` -- early-stopping trainer
+- ``utils``     -- serialization (ModelSerializer-style zips), pytree helpers
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,13 @@
+"""Datasets: DataSet container, iterators, built-in datasets.
+
+Reference: ND4J DataSet/MultiDataSet + deeplearning4j-core datasets/ (iterators,
+MNIST fetcher, Iris), deeplearning4j-nn datasets/iterator/ (async prefetch).
+"""
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.datasets.iterators import (
+    DataSetIterator,
+    ListDataSetIterator,
+    AsyncDataSetIterator,
+    MultipleEpochsIterator,
+)

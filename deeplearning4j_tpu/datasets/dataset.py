@@ -1,0 +1,85 @@
+"""DataSet / MultiDataSet containers (reference: ND4J DataSet/MultiDataSet).
+
+Arrays are host numpy; device transfer happens at the jitted-step boundary (the
+reference's workspace/device-affinity machinery is unnecessary — XLA owns device
+memory).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class DataSet:
+    """features [B,...], labels [B,...], optional masks [B,T]."""
+
+    def __init__(self, features, labels, features_mask=None, labels_mask=None):
+        self.features = np.asarray(features)
+        self.labels = np.asarray(labels)
+        self.features_mask = None if features_mask is None else np.asarray(features_mask)
+        self.labels_mask = None if labels_mask is None else np.asarray(labels_mask)
+
+    def num_examples(self) -> int:
+        return int(self.features.shape[0])
+
+    def split_test_and_train(self, n_train: int):
+        return (DataSet(self.features[:n_train], self.labels[:n_train],
+                        None if self.features_mask is None else self.features_mask[:n_train],
+                        None if self.labels_mask is None else self.labels_mask[:n_train]),
+                DataSet(self.features[n_train:], self.labels[n_train:],
+                        None if self.features_mask is None else self.features_mask[n_train:],
+                        None if self.labels_mask is None else self.labels_mask[n_train:]))
+
+    def shuffle(self, seed: Optional[int] = None):
+        rng = np.random.default_rng(seed)
+        idx = rng.permutation(self.num_examples())
+        self.features = self.features[idx]
+        self.labels = self.labels[idx]
+        if self.features_mask is not None:
+            self.features_mask = self.features_mask[idx]
+        if self.labels_mask is not None:
+            self.labels_mask = self.labels_mask[idx]
+
+    def batch_by(self, batch_size: int):
+        n = self.num_examples()
+        for s in range(0, n, batch_size):
+            sl = slice(s, min(s + batch_size, n))
+            yield DataSet(self.features[sl], self.labels[sl],
+                          None if self.features_mask is None else self.features_mask[sl],
+                          None if self.labels_mask is None else self.labels_mask[sl])
+
+    @staticmethod
+    def merge(datasets):
+        return DataSet(
+            np.concatenate([d.features for d in datasets]),
+            np.concatenate([d.labels for d in datasets]),
+            None if datasets[0].features_mask is None
+            else np.concatenate([d.features_mask for d in datasets]),
+            None if datasets[0].labels_mask is None
+            else np.concatenate([d.labels_mask for d in datasets]))
+
+
+class MultiDataSet:
+    """Multiple feature/label arrays (reference: ND4J MultiDataSet, used by
+    ComputationGraph multi-input/multi-output fit)."""
+
+    def __init__(self, features, labels, features_masks=None, labels_masks=None):
+        self.features = [np.asarray(f) for f in _as_list(features)]
+        self.labels = [np.asarray(l) for l in _as_list(labels)]
+        self.features_masks = ([None] * len(self.features) if features_masks is None
+                               else [None if m is None else np.asarray(m)
+                                     for m in _as_list(features_masks)])
+        self.labels_masks = ([None] * len(self.labels) if labels_masks is None
+                             else [None if m is None else np.asarray(m)
+                                   for m in _as_list(labels_masks)])
+
+    def num_examples(self) -> int:
+        return int(self.features[0].shape[0])
+
+
+def _as_list(x):
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]

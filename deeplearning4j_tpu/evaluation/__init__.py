@@ -1,0 +1,10 @@
+"""Evaluation: classification/regression metrics, ROC curves.
+
+Reference: deeplearning4j-nn eval/ (19 files): Evaluation.java:72,
+RegressionEvaluation.java:32, ROC.java:53, EvaluationBinary, curves/.
+"""
+
+from deeplearning4j_tpu.evaluation.classification import Evaluation
+from deeplearning4j_tpu.evaluation.regression import RegressionEvaluation
+from deeplearning4j_tpu.evaluation.roc import ROC, ROCBinary, ROCMultiClass
+from deeplearning4j_tpu.evaluation.binary import EvaluationBinary

@@ -1,0 +1,116 @@
+"""Classification evaluation: accuracy/precision/recall/F1 + confusion matrix.
+
+Reference: eval/Evaluation.java:72. Semantics match: predictions by argmax over the
+class axis; precision/recall macro-averaged over classes with at least one true or
+predicted example; masked timesteps excluded. Mergeable for distributed eval
+(reference: IEvaluation.merge used by Spark map-reduce evaluation).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class Evaluation:
+    def __init__(self, num_classes: Optional[int] = None, labels: Optional[list] = None):
+        self.num_classes = num_classes
+        self.label_names = labels
+        self.confusion: Optional[np.ndarray] = None
+
+    def _ensure(self, n):
+        if self.confusion is None:
+            self.num_classes = self.num_classes or n
+            self.confusion = np.zeros((self.num_classes, self.num_classes), np.int64)
+
+    def eval(self, labels, predictions, mask=None):
+        """labels/predictions: [B, C] (one-hot / prob) or [B, T, C] time series."""
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 3:
+            if mask is not None:
+                m = np.asarray(mask).astype(bool).reshape(-1)
+            else:
+                m = np.ones(labels.shape[0] * labels.shape[1], bool)
+            labels = labels.reshape(-1, labels.shape[-1])[m]
+            predictions = predictions.reshape(-1, predictions.shape[-1])[m]
+        self._ensure(labels.shape[-1])
+        true_idx = np.argmax(labels, axis=-1)
+        pred_idx = np.argmax(predictions, axis=-1)
+        np.add.at(self.confusion, (true_idx, pred_idx), 1)
+        return self
+
+    def merge(self, other: "Evaluation") -> "Evaluation":
+        if other.confusion is None:
+            return self
+        if self.confusion is None:
+            self.num_classes = other.num_classes
+            self.confusion = other.confusion.copy()
+        else:
+            self.confusion += other.confusion
+        return self
+
+    # ---- metrics ----------------------------------------------------------
+    def _counts(self):
+        cm = self.confusion
+        tp = np.diag(cm).astype(float)
+        fp = cm.sum(axis=0) - tp
+        fn = cm.sum(axis=1) - tp
+        return tp, fp, fn
+
+    def accuracy(self) -> float:
+        cm = self.confusion
+        total = cm.sum()
+        return float(np.diag(cm).sum() / total) if total else 0.0
+
+    def precision(self, cls: Optional[int] = None) -> float:
+        tp, fp, _ = self._counts()
+        if cls is not None:
+            d = tp[cls] + fp[cls]
+            return float(tp[cls] / d) if d else 0.0
+        valid = (tp + fp) > 0
+        if not valid.any():
+            return 0.0
+        return float(np.mean(tp[valid] / (tp[valid] + fp[valid])))
+
+    def recall(self, cls: Optional[int] = None) -> float:
+        tp, _, fn = self._counts()
+        if cls is not None:
+            d = tp[cls] + fn[cls]
+            return float(tp[cls] / d) if d else 0.0
+        valid = (tp + fn) > 0
+        if not valid.any():
+            return 0.0
+        return float(np.mean(tp[valid] / (tp[valid] + fn[valid])))
+
+    def f1(self, cls: Optional[int] = None) -> float:
+        p = self.precision(cls)
+        r = self.recall(cls)
+        return 2 * p * r / (p + r) if (p + r) else 0.0
+
+    def false_positive_rate(self, cls: int) -> float:
+        cm = self.confusion
+        tp, fp, fn = self._counts()
+        tn = cm.sum() - tp[cls] - fp[cls] - fn[cls]
+        d = fp[cls] + tn
+        return float(fp[cls] / d) if d else 0.0
+
+    def stats(self) -> str:
+        names = self.label_names or [str(i) for i in range(self.num_classes or 0)]
+        lines = [
+            "========================Evaluation Metrics========================",
+            f" # of classes: {self.num_classes}",
+            f" Accuracy:  {self.accuracy():.4f}",
+            f" Precision: {self.precision():.4f}",
+            f" Recall:    {self.recall():.4f}",
+            f" F1 Score:  {self.f1():.4f}",
+            "",
+            "=========================Confusion Matrix=========================",
+        ]
+        if self.confusion is not None:
+            header = "     " + " ".join(f"{n:>6}" for n in names)
+            lines.append(header)
+            for i, row in enumerate(self.confusion):
+                lines.append(f"{names[i]:>4} " + " ".join(f"{v:>6}" for v in row))
+        return "\n".join(lines)

@@ -1,0 +1,1 @@
+"""Core NN: config system, layers, networks, updaters (reference: deeplearning4j-nn)."""

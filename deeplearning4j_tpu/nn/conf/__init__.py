@@ -1,0 +1,7 @@
+"""Configuration system (reference: deeplearning4j-nn nn/conf/)."""
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.builders import (
+    NeuralNetConfiguration,
+    MultiLayerConfiguration,
+)

@@ -1,0 +1,272 @@
+"""NeuralNetConfiguration / MultiLayerConfiguration builders.
+
+Reference: nn/conf/NeuralNetConfiguration.java:517 (Builder), :703 (.list()),
+nn/conf/MultiLayerConfiguration.java. The fluent API shape matches the reference —
+global hyperparameters, then ``.list(...layers)``, then ``.input_type(...)`` and
+``.build()`` performs nIn inference + automatic preprocessor insertion (reference:
+InputType shape inference + InputPreProcessor auto-insertion).
+
+JSON round-trip: ``MultiLayerConfiguration.to_json()``/``from_json`` (reference:
+Jackson polymorphic JSON; our tags use ``@class`` via utils/serde.py).
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Optional
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import Layer
+from deeplearning4j_tpu.nn.conf.preprocessors import (
+    CnnToFeedForwardPreProcessor,
+    CnnToRnnPreProcessor,
+    FeedForwardToCnnPreProcessor,
+    InputPreProcessor,
+)
+from deeplearning4j_tpu.nn.updater import Sgd, Updater, get_updater
+from deeplearning4j_tpu.nn.weights import Distribution
+from deeplearning4j_tpu.utils import serde
+from deeplearning4j_tpu.utils.serde import register_serializable
+
+
+def default_preprocessor(input_type: InputType, layer: Layer) -> Optional[InputPreProcessor]:
+    """Choose the preprocessor the reference would auto-insert for this transition."""
+    kind_in = input_type.kind
+    expects = getattr(layer, "INPUT_KIND", "any")
+    if expects == "cnn":
+        if kind_in in ("convolutional_flat", "feed_forward"):
+            if input_type.height and input_type.width:
+                return FeedForwardToCnnPreProcessor(
+                    height=input_type.height, width=input_type.width,
+                    channels=input_type.channels)
+            raise ValueError(
+                f"Cannot feed {kind_in} input into CNN layer {layer} without "
+                "height/width info; use InputType.convolutional_flat(h, w, c)")
+        return None
+    if expects == "rnn":
+        if kind_in == "convolutional":
+            return CnnToRnnPreProcessor(height=input_type.height,
+                                        width=input_type.width,
+                                        channels=input_type.channels)
+        return None
+    if expects == "ff":
+        if kind_in == "convolutional":
+            return CnnToFeedForwardPreProcessor(height=input_type.height,
+                                                width=input_type.width,
+                                                channels=input_type.channels)
+        return None
+    return None
+
+
+@register_serializable
+@dataclass
+class MultiLayerConfiguration:
+    """Finalised sequential-network config (reference: MultiLayerConfiguration.java).
+
+    After ``build()``: every layer's None hyperparameters are resolved, nIn fields
+    are set, and ``preprocessors[i]`` holds the shape adapter applied before layer i.
+    """
+
+    layers: list = field(default_factory=list)
+    preprocessors: dict = field(default_factory=dict)  # {int: InputPreProcessor}
+    input_type: Optional[InputType] = None
+    seed: int = 0
+    updater: Updater = field(default_factory=lambda: Sgd(learning_rate=0.1))
+    backprop_type: str = "standard"  # standard | tbptt
+    tbptt_fwd_length: int = 20
+    tbptt_back_length: int = 20
+    pretrain: bool = False
+    dtype: str = "float32"
+    # per-layer input types computed at build time (after preprocessor)
+    layer_input_types: list = field(default_factory=list)
+
+    def to_json(self) -> str:
+        return serde.to_json(self)
+
+    @staticmethod
+    def from_json(s: str) -> "MultiLayerConfiguration":
+        conf = serde.from_json(s)
+        # JSON object keys are strings; restore int keys for preprocessors
+        conf.preprocessors = {int(k): v for k, v in conf.preprocessors.items()}
+        return conf
+
+    def n_layers(self) -> int:
+        return len(self.layers)
+
+
+class ListBuilder:
+    """Builder for MultiLayerConfiguration (reference: NeuralNetConfiguration
+    .Builder.list() -> ListBuilder)."""
+
+    def __init__(self, global_conf: "NeuralNetConfiguration", layers):
+        self._g = global_conf
+        self._layers = list(layers)
+        self._input_type: Optional[InputType] = None
+        self._preprocessors: dict[int, InputPreProcessor] = {}
+        self._backprop_type = "standard"
+        self._tbptt_fwd = 20
+        self._tbptt_back = 20
+        self._pretrain = False
+
+    def layer(self, layer: Layer, index: Optional[int] = None) -> "ListBuilder":
+        if index is None:
+            self._layers.append(layer)
+        else:
+            while len(self._layers) <= index:
+                self._layers.append(None)
+            self._layers[index] = layer
+        return self
+
+    def set_input_type(self, input_type: InputType) -> "ListBuilder":
+        self._input_type = input_type
+        return self
+
+    input_type = set_input_type
+
+    def input_pre_processor(self, index: int, p: InputPreProcessor) -> "ListBuilder":
+        self._preprocessors[index] = p
+        return self
+
+    def backprop_type(self, t: str, fwd_length: int = 20, back_length: int = 20
+                      ) -> "ListBuilder":
+        self._backprop_type = t
+        self._tbptt_fwd = fwd_length
+        self._tbptt_back = back_length
+        return self
+
+    def t_bptt_lengths(self, fwd: int, back: Optional[int] = None) -> "ListBuilder":
+        return self.backprop_type("tbptt", fwd, back if back is not None else fwd)
+
+    def pretrain(self, flag: bool) -> "ListBuilder":
+        self._pretrain = flag
+        return self
+
+    def build(self) -> MultiLayerConfiguration:
+        g = self._g
+        layers = [copy.deepcopy(l) for l in self._layers]
+        if any(l is None for l in layers):
+            raise ValueError("Gap in layer list (a .layer(index=...) was skipped)")
+        preprocessors = dict(self._preprocessors)
+        layer_input_types: list = []
+        cur = self._input_type
+        for i, layer in enumerate(layers):
+            layer.finalize(g)
+            if cur is not None:
+                if i not in preprocessors:
+                    auto = default_preprocessor(cur, layer)
+                    if auto is not None:
+                        preprocessors[i] = auto
+                if i in preprocessors:
+                    cur = preprocessors[i].output_type(cur)
+                layer_input_types.append(cur)
+                layer.set_n_in(cur)
+                layer.validate()
+                cur = layer.output_type(cur)
+            else:
+                layer_input_types.append(None)
+                layer.validate()
+        return MultiLayerConfiguration(
+            layers=layers,
+            preprocessors=preprocessors,
+            input_type=self._input_type,
+            seed=g.seed,
+            updater=copy.deepcopy(g.updater),
+            backprop_type=self._backprop_type,
+            tbptt_fwd_length=self._tbptt_fwd,
+            tbptt_back_length=self._tbptt_back,
+            pretrain=self._pretrain,
+            dtype=g.dtype,
+        )
+
+
+@register_serializable
+@dataclass
+class NeuralNetConfiguration:
+    """Global hyperparameter container + fluent builder entry point."""
+
+    seed: int = 12345
+    activation: Optional[str] = None
+    weight_init: Optional[str] = None
+    dist: Optional[Distribution] = None
+    bias_init: Optional[float] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    l1_bias: Optional[float] = None
+    l2_bias: Optional[float] = None
+    dropout: Optional[float] = None
+    updater: Updater = field(default_factory=lambda: Sgd(learning_rate=0.1))
+    dtype: str = "float32"
+    optimization_algo: str = "stochastic_gradient_descent"
+
+    @staticmethod
+    def builder() -> "NeuralNetConfigurationBuilder":
+        return NeuralNetConfigurationBuilder()
+
+
+class NeuralNetConfigurationBuilder:
+    def __init__(self):
+        self._c = NeuralNetConfiguration()
+
+    def seed(self, s: int):
+        self._c.seed = int(s)
+        return self
+
+    def activation(self, a: str):
+        self._c.activation = str(a).lower()
+        return self
+
+    def weight_init(self, w: str, dist: Optional[Distribution] = None):
+        self._c.weight_init = str(w).lower()
+        if dist is not None:
+            self._c.dist = dist
+        return self
+
+    def dist(self, d: Distribution):
+        self._c.dist = d
+        self._c.weight_init = "distribution"
+        return self
+
+    def bias_init(self, b: float):
+        self._c.bias_init = float(b)
+        return self
+
+    def l1(self, v: float):
+        self._c.l1 = float(v)
+        return self
+
+    def l2(self, v: float):
+        self._c.l2 = float(v)
+        return self
+
+    def dropout(self, v: float):
+        self._c.dropout = float(v)
+        return self
+
+    def updater(self, u, learning_rate: Optional[float] = None):
+        self._c.updater = get_updater(u, learning_rate)
+        return self
+
+    def learning_rate(self, lr: float):
+        self._c.updater.learning_rate = float(lr)
+        return self
+
+    def dtype(self, dt: str):
+        self._c.dtype = dt
+        return self
+
+    def optimization_algo(self, algo: str):
+        self._c.optimization_algo = algo
+        return self
+
+    def list(self, *layers) -> ListBuilder:
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)):
+            layers = tuple(layers[0])
+        return ListBuilder(self._c, layers)
+
+    def graph_builder(self):
+        from deeplearning4j_tpu.nn.conf.graph_conf import GraphBuilder
+        return GraphBuilder(self._c)
+
+    def build(self) -> NeuralNetConfiguration:
+        return self._c

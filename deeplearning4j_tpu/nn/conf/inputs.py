@@ -1,0 +1,60 @@
+"""InputType: shape metadata used for layer nIn inference + preprocessor insertion.
+
+Reference: nn/conf/inputs/InputType.java (FF / recurrent / convolutional /
+convolutionalFlat kinds). TPU-first divergence: image arrays are NHWC (the layout
+XLA:TPU prefers) and recurrent arrays are [batch, time, features] — the reference
+uses NCHW and [batch, features, time]. The *logical* config fields (height, width,
+depth/channels, size) keep the reference's meaning.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from deeplearning4j_tpu.utils.serde import register_serializable
+
+
+@register_serializable
+@dataclass(frozen=True)
+class InputType:
+    kind: str = "feed_forward"  # feed_forward | recurrent | convolutional | convolutional_flat
+    size: int = 0               # FF/recurrent feature count
+    timeseries_length: Optional[int] = None
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    @staticmethod
+    def feed_forward(size: int) -> "InputType":
+        return InputType(kind="feed_forward", size=int(size))
+
+    @staticmethod
+    def recurrent(size: int, timeseries_length: Optional[int] = None) -> "InputType":
+        return InputType(kind="recurrent", size=int(size),
+                         timeseries_length=timeseries_length)
+
+    @staticmethod
+    def convolutional(height: int, width: int, channels: int) -> "InputType":
+        return InputType(kind="convolutional", height=int(height), width=int(width),
+                         channels=int(channels))
+
+    @staticmethod
+    def convolutional_flat(height: int, width: int, channels: int) -> "InputType":
+        return InputType(kind="convolutional_flat", height=int(height),
+                         width=int(width), channels=int(channels),
+                         size=int(height) * int(width) * int(channels))
+
+    def flat_size(self) -> int:
+        if self.kind in ("feed_forward", "recurrent"):
+            return self.size
+        return self.height * self.width * self.channels
+
+    def array_shape(self, batch: int = -1):
+        """Concrete array shape (batch dim first; NHWC / [B,T,F] layouts)."""
+        if self.kind == "feed_forward" or self.kind == "convolutional_flat":
+            return (batch, self.flat_size())
+        if self.kind == "recurrent":
+            t = self.timeseries_length if self.timeseries_length else -1
+            return (batch, t, self.size)
+        return (batch, self.height, self.width, self.channels)

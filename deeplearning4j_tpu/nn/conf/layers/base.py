@@ -1,0 +1,177 @@
+"""Layer base classes.
+
+Reference: nn/conf/layers/Layer.java + BaseLayer hyperparameter fields, and the
+runtime contract of nn/api/Layer.java:37 (activate/backprop/masking). Here the
+contract is functional:
+
+- ``init_params(rng, dtype) -> dict[str, Array]``  (param shapes; flat-buffer order
+  given by ``param_order``)
+- ``init_state() -> dict``                          (e.g. BN running stats)
+- ``forward(params, state, x, *, mask, train, rng) -> (out, new_state)``
+
+``forward`` must be jax-traceable: no data-dependent Python control flow, static
+shapes only, so whole networks compile to one XLA program.
+
+Hyperparameter inheritance matches the reference's builder: fields left as ``None``
+on a layer are filled from the global ``NeuralNetConfiguration`` at build time
+(``finalize``), falling back to per-class defaults (``DEFAULT_ACTIVATION`` etc.).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.weights import Distribution, init_weight
+from deeplearning4j_tpu.ops.activations import Activation, get_activation
+
+
+@dataclass
+class Layer:
+    """Base for all layer configs. ``dropout`` is the probability of dropping each
+    input activation (inverted dropout on the layer *input*, matching the placement
+    in the reference's BaseLayer.activate -> Dropout.applyDropout,
+    nn/layers/BaseLayer.java:540-551)."""
+
+    name: Optional[str] = None
+    dropout: Optional[float] = None
+
+    # what array kind this layer consumes: ff | cnn | rnn | any
+    INPUT_KIND = "any"
+
+    # ---- config plumbing -------------------------------------------------------
+    def finalize(self, g=None) -> None:
+        """Fill None fields from the global conf ``g`` (NeuralNetConfiguration)."""
+        if self.dropout is None:
+            self.dropout = (g.dropout if g is not None and g.dropout is not None
+                            else 0.0)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def set_n_in(self, input_type: InputType) -> None:
+        """Infer nIn-like fields from the previous layer's output type (parity with
+        FeedForwardLayer.setNIn auto-config)."""
+
+    def validate(self) -> None:
+        pass
+
+    # ---- params ----------------------------------------------------------------
+    def param_order(self) -> list[str]:
+        return []
+
+    def init_params(self, rng, dtype=jnp.float32) -> dict:
+        return {}
+
+    def init_state(self) -> dict:
+        return {}
+
+    def has_params(self) -> bool:
+        return bool(self.param_order())
+
+    def regularization(self, params: dict):
+        """L1/L2 penalty contribution (reference: BaseLayer.calcL1/calcL2)."""
+        return 0.0
+
+    # ---- compute ---------------------------------------------------------------
+    def forward(self, params: dict, state: dict, x, *, mask=None, train: bool = False,
+                rng=None):
+        raise NotImplementedError
+
+    def apply_input_dropout(self, x, *, train: bool, rng):
+        p = self.dropout or 0.0
+        if train and p > 0.0 and rng is not None:
+            keep = 1.0 - p
+            m = jax.random.bernoulli(rng, keep, x.shape)
+            return jnp.where(m, x / keep, 0.0)
+        return x
+
+    def feed_forward_mask(self, mask, current_mask_state: str = "active"):
+        """How this layer transforms a time-mask (reference: Layer.feedForwardMaskArray)."""
+        return mask
+
+
+@dataclass
+class BaseLayer(Layer):
+    """Layers with weights: activation + init + regularisation hyperparams."""
+
+    activation: Optional[str] = None
+    weight_init: Optional[str] = None
+    dist: Optional[Distribution] = None
+    bias_init: Optional[float] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    l1_bias: Optional[float] = None
+    l2_bias: Optional[float] = None
+    # Per-layer learning-rate override (reference: BaseLayer.learningRate /
+    # biasLearningRate). None -> use the global updater learning rate.
+    learning_rate: Optional[float] = None
+
+    DEFAULT_ACTIVATION = "sigmoid"
+
+    def finalize(self, g=None) -> None:
+        super().finalize(g)
+        if self.activation is None:
+            self.activation = ((g.activation if g is not None else None)
+                               or self.DEFAULT_ACTIVATION)
+        if self.weight_init is None:
+            self.weight_init = ((g.weight_init if g is not None else None) or "xavier")
+        if self.dist is None and g is not None:
+            self.dist = g.dist
+        if self.bias_init is None:
+            self.bias_init = (g.bias_init if g is not None and g.bias_init is not None
+                              else 0.0)
+        for f, gf in (("l1", "l1"), ("l2", "l2"), ("l1_bias", "l1_bias"),
+                      ("l2_bias", "l2_bias")):
+            if getattr(self, f) is None:
+                gv = getattr(g, gf, None) if g is not None else None
+                setattr(self, f, gv if gv is not None else 0.0)
+
+    def act(self) -> Activation:
+        return get_activation(self.activation or self.DEFAULT_ACTIVATION)
+
+    def _init_w(self, rng, shape, fan_in, fan_out, dtype):
+        return init_weight(rng, shape, fan_in, fan_out,
+                           self.weight_init or "xavier", self.dist, dtype)
+
+    def regularization(self, params: dict):
+        reg = 0.0
+        l1 = self.l1 or 0.0
+        l2 = self.l2 or 0.0
+        l1b = self.l1_bias or 0.0
+        l2b = self.l2_bias or 0.0
+        for k, v in params.items():
+            if k.startswith("b") or k in ("beta", "mb", "lb", "db", "rb", "eb", "vb"):
+                if l2b > 0:
+                    reg = reg + 0.5 * l2b * jnp.sum(v * v)
+                if l1b > 0:
+                    reg = reg + l1b * jnp.sum(jnp.abs(v))
+            else:
+                if l2 > 0:
+                    reg = reg + 0.5 * l2 * jnp.sum(v * v)
+                if l1 > 0:
+                    reg = reg + l1 * jnp.sum(jnp.abs(v))
+        return reg
+
+
+@dataclass
+class FeedForwardLayer(BaseLayer):
+    """Dense-style layers with explicit nIn/nOut (reference: FeedForwardLayer.java)."""
+
+    n_in: int = 0
+    n_out: int = 0
+
+    INPUT_KIND = "ff"
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if self.n_in == 0:
+            self.n_in = input_type.flat_size()
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if input_type.kind == "recurrent":
+            return InputType.recurrent(self.n_out, input_type.timeseries_length)
+        return InputType.feed_forward(self.n_out)

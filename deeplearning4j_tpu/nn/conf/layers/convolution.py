@@ -1,0 +1,381 @@
+"""Convolution / pooling / padding layers (NHWC, MXU-native).
+
+Reference impls these replace: nn/layers/convolution/ConvolutionLayer.java:179-224
+(im2col + gemm) and nn/layers/convolution/subsampling/SubsamplingLayer.java, plus the
+cuDNN helpers (deeplearning4j-cuda CudnnConvolutionHelper.java:54,
+CudnnSubsamplingHelper.java:49). On TPU there is no im2col and no helper SPI: convs
+lower straight to `lax.conv_general_dilated` (MXU systolic matmuls) and pooling to
+`lax.reduce_window`; XLA fuses bias+activation into the conv epilogue.
+
+ConvolutionMode semantics follow nn/conf/ConvolutionMode.java: Strict (shapes must
+divide exactly), Truncate (floor), Same (auto-pad, ceil(in/stride)).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import BaseLayer, Layer
+from deeplearning4j_tpu.utils.serde import register_serializable
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return (int(v[0]), int(v[1]))
+    return (int(v), int(v))
+
+
+def conv_out_size(size: int, k: int, s: int, p: int, mode: str) -> int:
+    if mode == "same":
+        return int(math.ceil(size / s))
+    out = (size - k + 2 * p) // s + 1
+    if mode == "strict" and (size - k + 2 * p) % s != 0:
+        raise ValueError(
+            f"ConvolutionMode.Strict: (in={size} - k={k} + 2*p={p}) not divisible by "
+            f"stride {s}; use mode='truncate' or 'same'")
+    return out
+
+
+def _conv_padding(mode: str, pad):
+    if mode == "same":
+        return "SAME"
+    ph, pw = _pair(pad)
+    return [(ph, ph), (pw, pw)]
+
+
+@register_serializable
+@dataclass
+class ConvolutionLayer(BaseLayer):
+    """2-D convolution. Kernel [kh, kw, c_in, c_out] (HWIO); arrays NHWC."""
+
+    n_in: int = 0   # input channels (auto-set from InputType)
+    n_out: int = 0  # output channels
+    kernel_size: tuple = (5, 5)
+    stride: tuple = (1, 1)
+    padding: tuple = (0, 0)
+    convolution_mode: str = "truncate"  # strict | truncate | same
+    dilation: tuple = (1, 1)
+
+    INPUT_KIND = "cnn"
+    DEFAULT_ACTIVATION = "identity"
+
+    def __post_init__(self):
+        self.kernel_size = _pair(self.kernel_size)
+        self.stride = _pair(self.stride)
+        self.padding = _pair(self.padding)
+        self.dilation = _pair(self.dilation)
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if self.n_in == 0:
+            if input_type.kind not in ("convolutional", "convolutional_flat"):
+                raise ValueError(f"ConvolutionLayer expects CNN input, got {input_type}")
+            self.n_in = input_type.channels
+
+    def output_type(self, input_type: InputType) -> InputType:
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        ph, pw = self.padding
+        h = conv_out_size(input_type.height, kh, sh, ph, self.convolution_mode)
+        w = conv_out_size(input_type.width, kw, sw, pw, self.convolution_mode)
+        return InputType.convolutional(h, w, self.n_out)
+
+    def param_order(self):
+        return ["W", "b"]
+
+    def init_params(self, rng, dtype=jnp.float32):
+        kh, kw = self.kernel_size
+        fan_in = self.n_in * kh * kw
+        fan_out = self.n_out * kh * kw
+        kw_key, _ = jax.random.split(rng)
+        W = self._init_w(kw_key, (kh, kw, self.n_in, self.n_out), fan_in, fan_out, dtype)
+        b = jnp.full((self.n_out,), self.bias_init, dtype)
+        return {"W": W, "b": b}
+
+    def preactivate(self, params, x):
+        out = lax.conv_general_dilated(
+            x, params["W"],
+            window_strides=self.stride,
+            padding=_conv_padding(self.convolution_mode, self.padding),
+            rhs_dilation=self.dilation,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return out + params["b"]
+
+    def forward(self, params, state, x, *, mask=None, train=False, rng=None):
+        x = self.apply_input_dropout(x, train=train, rng=rng)
+        return self.act()(self.preactivate(params, x)), state
+
+
+@register_serializable
+@dataclass
+class Deconvolution2D(ConvolutionLayer):
+    """Transposed convolution (fractionally-strided)."""
+
+    def output_type(self, input_type: InputType) -> InputType:
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        ph, pw = self.padding
+        if self.convolution_mode == "same":
+            h, w = input_type.height * sh, input_type.width * sw
+        else:
+            h = sh * (input_type.height - 1) + kh - 2 * ph
+            w = sw * (input_type.width - 1) + kw - 2 * pw
+        return InputType.convolutional(h, w, self.n_out)
+
+    def init_params(self, rng, dtype=jnp.float32):
+        kh, kw = self.kernel_size
+        fan_in = self.n_in * kh * kw
+        fan_out = self.n_out * kh * kw
+        kw_key, _ = jax.random.split(rng)
+        W = self._init_w(kw_key, (kh, kw, self.n_in, self.n_out), fan_in, fan_out, dtype)
+        b = jnp.full((self.n_out,), self.bias_init, dtype)
+        return {"W": W, "b": b}
+
+    def preactivate(self, params, x):
+        if self.convolution_mode == "same":
+            padding = "SAME"
+        else:
+            ph, pw = self.padding
+            kh, kw = self.kernel_size
+            padding = [(kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw)]
+        out = lax.conv_transpose(
+            x, params["W"], strides=self.stride, padding=padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return out + params["b"]
+
+
+@register_serializable
+@dataclass
+class SeparableConvolution2D(ConvolutionLayer):
+    """Depthwise + pointwise convolution."""
+
+    depth_multiplier: int = 1
+
+    def param_order(self):
+        return ["dW", "pW", "b"]
+
+    def init_params(self, rng, dtype=jnp.float32):
+        kh, kw = self.kernel_size
+        k1, k2, _ = jax.random.split(rng, 3)
+        mid = self.n_in * self.depth_multiplier
+        dW = self._init_w(k1, (kh, kw, 1, mid), kh * kw, kh * kw * self.depth_multiplier,
+                          dtype)
+        pW = self._init_w(k2, (1, 1, mid, self.n_out), mid, self.n_out, dtype)
+        b = jnp.full((self.n_out,), self.bias_init, dtype)
+        return {"dW": dW, "pW": pW, "b": b}
+
+    def preactivate(self, params, x):
+        depthwise = lax.conv_general_dilated(
+            x, params["dW"], window_strides=self.stride,
+            padding=_conv_padding(self.convolution_mode, self.padding),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=self.n_in)
+        pointwise = lax.conv_general_dilated(
+            depthwise, params["pW"], window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return pointwise + params["b"]
+
+
+@register_serializable
+@dataclass
+class Convolution1DLayer(BaseLayer):
+    """1-D (temporal) convolution over [batch, time, features]."""
+
+    n_in: int = 0
+    n_out: int = 0
+    kernel_size: int = 5
+    stride: int = 1
+    padding: int = 0
+    convolution_mode: str = "same"
+
+    INPUT_KIND = "rnn"
+    DEFAULT_ACTIVATION = "identity"
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if self.n_in == 0:
+            self.n_in = input_type.size
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t = input_type.timeseries_length
+        if t is not None:
+            t = conv_out_size(t, self.kernel_size, self.stride, self.padding,
+                              self.convolution_mode)
+        return InputType.recurrent(self.n_out, t)
+
+    def param_order(self):
+        return ["W", "b"]
+
+    def init_params(self, rng, dtype=jnp.float32):
+        k = self.kernel_size
+        kw_key, _ = jax.random.split(rng)
+        W = self._init_w(kw_key, (k, self.n_in, self.n_out), self.n_in * k,
+                         self.n_out * k, dtype)
+        b = jnp.full((self.n_out,), self.bias_init, dtype)
+        return {"W": W, "b": b}
+
+    def forward(self, params, state, x, *, mask=None, train=False, rng=None):
+        x = self.apply_input_dropout(x, train=train, rng=rng)
+        if self.convolution_mode == "same":
+            padding = "SAME"
+        else:
+            padding = [(self.padding, self.padding)]
+        out = lax.conv_general_dilated(
+            x, params["W"], window_strides=(self.stride,), padding=padding,
+            dimension_numbers=("NWC", "WIO", "NWC")) + params["b"]
+        return self.act()(out), state
+
+
+@register_serializable
+@dataclass
+class SubsamplingLayer(Layer):
+    """2-D pooling: MAX / AVG / SUM / PNORM via lax.reduce_window."""
+
+    pooling_type: str = "max"
+    kernel_size: tuple = (2, 2)
+    stride: tuple = (2, 2)
+    padding: tuple = (0, 0)
+    convolution_mode: str = "truncate"
+    pnorm: int = 2
+
+    INPUT_KIND = "cnn"
+
+    def __post_init__(self):
+        self.kernel_size = _pair(self.kernel_size)
+        self.stride = _pair(self.stride)
+        self.padding = _pair(self.padding)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        ph, pw = self.padding
+        h = conv_out_size(input_type.height, kh, sh, ph, self.convolution_mode)
+        w = conv_out_size(input_type.width, kw, sw, pw, self.convolution_mode)
+        return InputType.convolutional(h, w, input_type.channels)
+
+    def _window_padding(self):
+        if self.convolution_mode == "same":
+            return "SAME"
+        ph, pw = self.padding
+        return [(0, 0), (ph, ph), (pw, pw), (0, 0)]
+
+    def forward(self, params, state, x, *, mask=None, train=False, rng=None):
+        x = self.apply_input_dropout(x, train=train, rng=rng)
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        window = (1, kh, kw, 1)
+        strides = (1, sh, sw, 1)
+        padding = self._window_padding()
+        pt = self.pooling_type.lower()
+        if pt == "max":
+            out = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, padding)
+        elif pt in ("avg", "sum"):
+            out = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+            if pt == "avg":
+                ones = jnp.ones_like(x)
+                counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
+                out = out / counts
+        elif pt == "pnorm":
+            p = float(self.pnorm)
+            out = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, window, strides,
+                                    padding) ** (1.0 / p)
+        else:
+            raise ValueError(f"Unknown pooling type '{self.pooling_type}'")
+        return out, state
+
+
+@register_serializable
+@dataclass
+class Subsampling1DLayer(Layer):
+    """1-D pooling over [batch, time, features]."""
+
+    pooling_type: str = "max"
+    kernel_size: int = 2
+    stride: int = 2
+    padding: int = 0
+    convolution_mode: str = "truncate"
+
+    INPUT_KIND = "rnn"
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t = input_type.timeseries_length
+        if t is not None:
+            t = conv_out_size(t, self.kernel_size, self.stride, self.padding,
+                              self.convolution_mode)
+        return InputType.recurrent(input_type.size, t)
+
+    def forward(self, params, state, x, *, mask=None, train=False, rng=None):
+        window = (1, self.kernel_size, 1)
+        strides = (1, self.stride, 1)
+        if self.convolution_mode == "same":
+            padding = "SAME"
+        else:
+            padding = [(0, 0), (self.padding, self.padding), (0, 0)]
+        if self.pooling_type.lower() == "max":
+            out = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, padding)
+        else:
+            out = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+            if self.pooling_type.lower() == "avg":
+                counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window,
+                                           strides, padding)
+                out = out / counts
+        return out, state
+
+
+@register_serializable
+@dataclass
+class ZeroPaddingLayer(Layer):
+    """Spatial zero padding [(top, bottom), (left, right)]."""
+
+    pad_top: int = 0
+    pad_bottom: int = 0
+    pad_left: int = 0
+    pad_right: int = 0
+
+    INPUT_KIND = "cnn"
+
+    @staticmethod
+    def of(pad):
+        if isinstance(pad, int):
+            return ZeroPaddingLayer(pad_top=pad, pad_bottom=pad, pad_left=pad,
+                                    pad_right=pad)
+        if len(pad) == 2:
+            return ZeroPaddingLayer(pad_top=pad[0], pad_bottom=pad[0],
+                                    pad_left=pad[1], pad_right=pad[1])
+        return ZeroPaddingLayer(pad_top=pad[0], pad_bottom=pad[1], pad_left=pad[2],
+                                pad_right=pad[3])
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.convolutional(
+            input_type.height + self.pad_top + self.pad_bottom,
+            input_type.width + self.pad_left + self.pad_right,
+            input_type.channels)
+
+    def forward(self, params, state, x, *, mask=None, train=False, rng=None):
+        out = jnp.pad(x, ((0, 0), (self.pad_top, self.pad_bottom),
+                          (self.pad_left, self.pad_right), (0, 0)))
+        return out, state
+
+
+@register_serializable
+@dataclass
+class Upsampling2D(Layer):
+    """Nearest-neighbour upsampling by integer factor."""
+
+    size: int = 2
+
+    INPUT_KIND = "cnn"
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.convolutional(input_type.height * self.size,
+                                       input_type.width * self.size,
+                                       input_type.channels)
+
+    def forward(self, params, state, x, *, mask=None, train=False, rng=None):
+        out = jnp.repeat(jnp.repeat(x, self.size, axis=1), self.size, axis=2)
+        return out, state

@@ -1,0 +1,180 @@
+"""Core feed-forward layers: Dense, Output, Loss, Activation, Dropout, Embedding,
+AutoEncoder.
+
+Reference impls these replace: nn/layers/feedforward/dense/DenseLayer.java (im2col-free
+XW+b), nn/layers/BaseOutputLayer.java (loss+gradient), nn/layers/feedforward/embedding/
+EmbeddingLayer.java, nn/layers/feedforward/autoencoder/AutoEncoder.java. Backward
+passes are jax.grad; dense matmuls hit the MXU directly via jnp.dot.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import BaseLayer, FeedForwardLayer, Layer
+from deeplearning4j_tpu.ops.losses import LossFunction, get_loss
+from deeplearning4j_tpu.utils.serde import register_serializable
+
+
+@register_serializable
+@dataclass
+class DenseLayer(FeedForwardLayer):
+    """Fully-connected layer: activation(x @ W + b). W: [n_in, n_out]."""
+
+    def param_order(self):
+        return ["W", "b"]
+
+    def init_params(self, rng, dtype=jnp.float32):
+        kw, _ = jax.random.split(rng)
+        W = self._init_w(kw, (self.n_in, self.n_out), self.n_in, self.n_out, dtype)
+        b = jnp.full((self.n_out,), self.bias_init, dtype)
+        return {"W": W, "b": b}
+
+    def preactivate(self, params, x):
+        return jnp.dot(x, params["W"]) + params["b"]
+
+    def forward(self, params, state, x, *, mask=None, train=False, rng=None):
+        x = self.apply_input_dropout(x, train=train, rng=rng)
+        return self.act()(self.preactivate(params, x)), state
+
+
+@register_serializable
+@dataclass
+class OutputLayer(DenseLayer):
+    """Dense + loss head (reference: nn/conf/layers/OutputLayer + BaseOutputLayer).
+
+    The training loss is computed from this layer's *pre-activations* so fused
+    softmax/sigmoid cross-entropy forms can be used.
+    """
+
+    loss: str = "mcxent"
+
+    DEFAULT_ACTIVATION = "softmax"
+
+    def loss_fn(self) -> LossFunction:
+        return get_loss(self.loss)
+
+    def compute_loss_per_example(self, params, x, labels, weights=None):
+        pre = self.preactivate(params, x)
+        return self.loss_fn().per_example(labels, pre, self.act(), weights)
+
+
+@register_serializable
+@dataclass
+class LossLayer(BaseLayer):
+    """Loss-only head, no params (reference: nn/conf/layers/LossLayer)."""
+
+    loss: str = "mcxent"
+
+    DEFAULT_ACTIVATION = "identity"
+
+    def loss_fn(self) -> LossFunction:
+        return get_loss(self.loss)
+
+    def preactivate(self, params, x):
+        return x
+
+    def forward(self, params, state, x, *, mask=None, train=False, rng=None):
+        x = self.apply_input_dropout(x, train=train, rng=rng)
+        return self.act()(x), state
+
+    def compute_loss_per_example(self, params, x, labels, weights=None):
+        return self.loss_fn().per_example(labels, x, self.act(), weights)
+
+
+@register_serializable
+@dataclass
+class ActivationLayer(BaseLayer):
+    """Parameterless activation (reference: nn/conf/layers/ActivationLayer)."""
+
+    def forward(self, params, state, x, *, mask=None, train=False, rng=None):
+        x = self.apply_input_dropout(x, train=train, rng=rng)
+        return self.act()(x), state
+
+
+@register_serializable
+@dataclass
+class DropoutLayer(BaseLayer):
+    """Standalone dropout layer (reference: nn/conf/layers/DropoutLayer)."""
+
+    DEFAULT_ACTIVATION = "identity"
+
+    def forward(self, params, state, x, *, mask=None, train=False, rng=None):
+        x = self.apply_input_dropout(x, train=train, rng=rng)
+        return self.act()(x), state
+
+
+@register_serializable
+@dataclass
+class EmbeddingLayer(FeedForwardLayer):
+    """Index lookup: int inputs [B] or [B,1] -> rows of W, plus bias.
+
+    Reference: nn/layers/feedforward/embedding/EmbeddingLayer.java (equivalent to a
+    one-hot matmul; implemented as a gather, which XLA lowers to dynamic-slice —
+    efficient on TPU for inference; the backward is a scatter-add).
+    """
+
+    DEFAULT_ACTIVATION = "identity"
+
+    def param_order(self):
+        return ["W", "b"]
+
+    def init_params(self, rng, dtype=jnp.float32):
+        kw, _ = jax.random.split(rng)
+        W = self._init_w(kw, (self.n_in, self.n_out), self.n_in, self.n_out, dtype)
+        b = jnp.full((self.n_out,), self.bias_init, dtype)
+        return {"W": W, "b": b}
+
+    def forward(self, params, state, x, *, mask=None, train=False, rng=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 2 and idx.shape[-1] == 1:
+            idx = idx[:, 0]
+        out = jnp.take(params["W"], idx, axis=0) + params["b"]
+        return self.act()(out), state
+
+
+@register_serializable
+@dataclass
+class AutoEncoder(FeedForwardLayer):
+    """Denoising autoencoder (reference: nn/conf/layers/AutoEncoder +
+    nn/layers/feedforward/autoencoder/AutoEncoder.java).
+
+    Supervised forward acts as the encoder (Dense). Pretraining uses
+    ``reconstruction_loss``: corrupt input, encode, decode with tied-ish weights
+    (W^T + visible bias), score vs the clean input.
+    """
+
+    corruption_level: float = 0.3
+    sparsity: float = 0.0
+    loss: str = "mse"
+
+    def param_order(self):
+        return ["W", "b", "vb"]
+
+    def init_params(self, rng, dtype=jnp.float32):
+        kw, _ = jax.random.split(rng)
+        W = self._init_w(kw, (self.n_in, self.n_out), self.n_in, self.n_out, dtype)
+        return {"W": W, "b": jnp.full((self.n_out,), self.bias_init, dtype),
+                "vb": jnp.zeros((self.n_in,), dtype)}
+
+    def preactivate(self, params, x):
+        return jnp.dot(x, params["W"]) + params["b"]
+
+    def forward(self, params, state, x, *, mask=None, train=False, rng=None):
+        x = self.apply_input_dropout(x, train=train, rng=rng)
+        return self.act()(self.preactivate(params, x)), state
+
+    def reconstruction_loss_per_example(self, params, x, rng=None):
+        corrupted = x
+        if rng is not None and self.corruption_level > 0:
+            keep = 1.0 - self.corruption_level
+            m = jax.random.bernoulli(rng, keep, x.shape)
+            corrupted = jnp.where(m, x, 0.0)
+        hidden = self.act()(jnp.dot(corrupted, params["W"]) + params["b"])
+        recon_pre = jnp.dot(hidden, params["W"].T) + params["vb"]
+        return get_loss(self.loss).per_example(x, recon_pre, self.act(), None)

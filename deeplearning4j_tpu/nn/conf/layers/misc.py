@@ -1,0 +1,86 @@
+"""Misc layers: FrozenLayer (transfer learning), CenterLossOutputLayer.
+
+Reference: nn/layers/FrozenLayer.java (wraps a layer, zeroes its updates) and
+nn/conf/layers/CenterLossOutputLayer.java. Freezing here is functional: the network
+applies jax.lax.stop_gradient to a frozen layer's params, so its gradients are
+exactly zero and the updater never moves them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import Layer
+from deeplearning4j_tpu.nn.conf.layers.core import OutputLayer
+from deeplearning4j_tpu.utils.serde import register_serializable
+
+
+@register_serializable
+@dataclass
+class FrozenLayer(Layer):
+    """Wraps any layer; its params receive zero gradient (stop_gradient)."""
+
+    inner: Optional[Layer] = None
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return self.inner.output_type(input_type)
+
+    def set_n_in(self, input_type: InputType) -> None:
+        self.inner.set_n_in(input_type)
+
+    def param_order(self):
+        return self.inner.param_order()
+
+    def init_params(self, rng, dtype=jnp.float32):
+        return self.inner.init_params(rng, dtype)
+
+    def init_state(self):
+        return self.inner.init_state()
+
+    def feed_forward_mask(self, mask, current_mask_state="active"):
+        return self.inner.feed_forward_mask(mask, current_mask_state)
+
+    def forward(self, params, state, x, *, mask=None, train=False, rng=None):
+        frozen = jax.tree_util.tree_map(jax.lax.stop_gradient, params)
+        # inference-mode semantics for the wrapped layer (no dropout on frozen layers)
+        return self.inner.forward(frozen, state, x, mask=mask, train=False, rng=rng)
+
+
+@register_serializable
+@dataclass
+class CenterLossOutputLayer(OutputLayer):
+    """Softmax + center loss (reference: CenterLossOutputLayer; Wen et al. 2016).
+
+    loss = mcxent + (lambda/2) * ||features - center_{label}||^2. Class centers live
+    in the layer *state* and are updated with an ``alpha`` moving average outside the
+    gradient (matching the reference's non-gradient center update).
+    """
+
+    alpha: float = 0.05
+    lambda_: float = 2e-4
+
+    def init_state(self):
+        return {"centers": jnp.zeros((self.n_out, self.n_in))}
+
+    def compute_loss_per_example(self, params, x, labels, weights=None, state=None):
+        base = super().compute_loss_per_example(params, x, labels, weights)
+        if state is None:
+            return base
+        centers = jax.lax.stop_gradient(state["centers"])  # [n_classes, n_in]
+        assigned = jnp.dot(labels, centers)  # one-hot labels -> per-example center
+        center_l = 0.5 * self.lambda_ * jnp.sum((x - assigned) ** 2, axis=-1)
+        return base + center_l
+
+    def update_centers(self, state, x, labels):
+        """Moving-average center update: c_j += alpha * mean_{i: y_i=j}(x_i - c_j)."""
+        centers = state["centers"]
+        counts = jnp.maximum(jnp.sum(labels, axis=0), 1.0)[:, None]  # [n_classes, 1]
+        assigned = jnp.dot(labels, centers)
+        diff_sum = jnp.dot(labels.T, x - assigned)  # [n_classes, n_in]
+        new_centers = centers + self.alpha * diff_sum / counts
+        return {**state, "centers": new_centers}

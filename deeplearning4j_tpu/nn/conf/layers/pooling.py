@@ -1,0 +1,79 @@
+"""Global pooling (reference: nn/conf/layers/GlobalPoolingLayer +
+nn/layers/pooling/GlobalPoolingLayer.java). Mask-aware over time for RNN data."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import Layer
+from deeplearning4j_tpu.utils.serde import register_serializable
+
+
+class PoolingType:
+    MAX = "max"
+    AVG = "avg"
+    SUM = "sum"
+    PNORM = "pnorm"
+
+
+@register_serializable
+@dataclass
+class GlobalPoolingLayer(Layer):
+    """Pool over time ([B,T,F] -> [B,F]) or space ([B,H,W,C] -> [B,C]).
+
+    For masked time series, masked steps are excluded (MAX uses -inf fill, AVG/SUM
+    exclude masked elements from numerator/denominator) — matching the reference's
+    masked pooling semantics.
+    """
+
+    pooling_type: str = "max"
+    pnorm: int = 2
+    collapse_dimensions: bool = True
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if input_type.kind == "recurrent":
+            return InputType.feed_forward(input_type.size)
+        if input_type.kind == "convolutional":
+            return InputType.feed_forward(input_type.channels)
+        return input_type
+
+    def feed_forward_mask(self, mask, current_mask_state: str = "active"):
+        return None  # pooling collapses the time dimension; mask is consumed
+
+    def forward(self, params, state, x, *, mask=None, train=False, rng=None):
+        if x.ndim == 3:
+            axes = (1,)
+        elif x.ndim == 4:
+            axes = (1, 2)
+        else:
+            raise ValueError(f"GlobalPooling expects 3-D or 4-D input, got {x.shape}")
+        pt = self.pooling_type.lower()
+        if mask is not None and x.ndim == 3:
+            m = mask.astype(x.dtype)[:, :, None]
+            if pt == "max":
+                out = jnp.max(jnp.where(m > 0, x, -jnp.inf), axis=1)
+            elif pt == "sum":
+                out = jnp.sum(x * m, axis=1)
+            elif pt == "avg":
+                out = jnp.sum(x * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0)
+            elif pt == "pnorm":
+                p = float(self.pnorm)
+                out = jnp.sum((jnp.abs(x) * m) ** p, axis=1) ** (1.0 / p)
+            else:
+                raise ValueError(f"Unknown pooling type '{self.pooling_type}'")
+            return out, state
+        if pt == "max":
+            out = jnp.max(x, axis=axes)
+        elif pt == "sum":
+            out = jnp.sum(x, axis=axes)
+        elif pt == "avg":
+            out = jnp.mean(x, axis=axes)
+        elif pt == "pnorm":
+            p = float(self.pnorm)
+            out = jnp.sum(jnp.abs(x) ** p, axis=axes) ** (1.0 / p)
+        else:
+            raise ValueError(f"Unknown pooling type '{self.pooling_type}'")
+        return out, state

@@ -1,0 +1,121 @@
+"""Input preprocessors: shape adapters auto-inserted between layer kinds.
+
+Reference: nn/conf/preprocessor/* (CnnToFeedForward, FeedForwardToCnn, RnnToFeedForward,
+FeedForwardToRnn, CnnToRnn, RnnToCnn). With autodiff, only the forward reshape is
+needed — jax derives the backward reshape. Layouts: NHWC, [B,T,F].
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.utils.serde import register_serializable
+
+
+@dataclass
+class InputPreProcessor:
+    def forward(self, x):
+        raise NotImplementedError
+
+    def output_type(self, input_type: InputType) -> InputType:
+        raise NotImplementedError
+
+    def feed_forward_mask(self, mask):
+        return mask
+
+
+@register_serializable
+@dataclass
+class CnnToFeedForwardPreProcessor(InputPreProcessor):
+    """[B,H,W,C] -> [B, H*W*C]."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def forward(self, x):
+        return x.reshape(x.shape[0], -1)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(input_type.flat_size())
+
+
+@register_serializable
+@dataclass
+class FeedForwardToCnnPreProcessor(InputPreProcessor):
+    """[B, H*W*C] -> [B,H,W,C]."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def forward(self, x):
+        return x.reshape(x.shape[0], self.height, self.width, self.channels)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+@register_serializable
+@dataclass
+class RnnToFeedForwardPreProcessor(InputPreProcessor):
+    """[B,T,F] -> [B*T,F] (reference reshapes 3d->2d for dense layers; our dense
+    layers broadcast over time natively, so this is only used when explicitly set)."""
+
+    def forward(self, x):
+        return x.reshape(-1, x.shape[-1])
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(input_type.size)
+
+
+@register_serializable
+@dataclass
+class FeedForwardToRnnPreProcessor(InputPreProcessor):
+    """[B*T,F] -> [B,T,F]. Needs the time length at call sites; with static shapes we
+    instead expand a plain [B,F] to [B,1,F]."""
+
+    def forward(self, x):
+        return x[:, None, :]
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(input_type.flat_size())
+
+
+@register_serializable
+@dataclass
+class CnnToRnnPreProcessor(InputPreProcessor):
+    """[B*T,H,W,C] is not expressible with static batch; the supported form is
+    [B,H,W,C] -> [B, H*W (time), C (features)] — per-row sequence (reference uses it
+    for video/frame data with explicit shapes)."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def forward(self, x):
+        b, h, w, c = x.shape
+        return x.reshape(b, h * w, c)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(input_type.channels,
+                                   input_type.height * input_type.width)
+
+
+@register_serializable
+@dataclass
+class RnnToCnnPreProcessor(InputPreProcessor):
+    """[B,T,F] -> [B,H,W,C] with T = H*W, F = C."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def forward(self, x):
+        return x.reshape(x.shape[0], self.height, self.width, self.channels)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.convolutional(self.height, self.width, self.channels)

@@ -1,0 +1,402 @@
+"""MultiLayerNetwork: the sequential-network training stack.
+
+Reference: nn/multilayer/MultiLayerNetwork.java:82 (2909 LoC) — init/param-flattening
+(:443-493), fit loop (:1047-1145), feedForward (:753), backprop (:1148,1163), TBPTT
+(:1364), output (:1717-1760), rnnTimeStep streaming state.
+
+TPU-native design: parameters are a pytree ``{layer_idx: {name: Array}}``; the whole
+fit iteration — forward, loss, jax.grad backward, updater — is ONE jitted XLA program
+(the reference's Solver/StochasticGradientDescent/updater call stack collapses into
+it). The reference's flat-parameter-view contract (one contiguous buffer, layer
+params as views) is preserved through ``params_flat()``/``set_params_flat`` for
+serialization and parameter-averaging parity.
+
+TBPTT matches MultiLayerNetwork.doTruncatedBPTT: the sequence is segmented on the
+time axis, hidden state (h, c) carries across segments with stop_gradient, and each
+segment is one jitted step.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.builders import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.conf.layers.misc import CenterLossOutputLayer
+from deeplearning4j_tpu.utils.pytree import flatten_params, unflatten_params
+
+_RNN_KEYS = ("h", "c")
+
+
+def _split_state(state):
+    """Split a layer-state dict into (persistent, rnn-carry) parts."""
+    persistent, carry = {}, {}
+    for k, v in state.items():
+        (carry if k in _RNN_KEYS else persistent)[k] = v
+    return persistent, carry
+
+
+class MultiLayerNetwork:
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.layers = conf.layers
+        self.params: dict = {}
+        self.state: dict = {}
+        self.updater_state: dict = {}
+        self.iteration = 0
+        self.epoch = 0
+        self.listeners: list = []
+        self.score_value: float = float("nan")
+        self._step_cache: dict = {}
+        self._output_cache: dict = {}
+        self._rnn_state: Optional[dict] = None  # streaming rnnTimeStep state
+        out = self.layers[-1] if self.layers else None
+        self._has_loss_head = hasattr(out, "compute_loss_per_example")
+
+    # ------------------------------------------------------------------ init
+    def init(self, params: Optional[dict] = None) -> "MultiLayerNetwork":
+        dtype = jnp.dtype(self.conf.dtype)
+        rng = jax.random.PRNGKey(self.conf.seed)
+        keys = jax.random.split(rng, max(len(self.layers), 1))
+        if params is None:
+            self.params = {str(i): l.init_params(keys[i], dtype)
+                           for i, l in enumerate(self.layers)}
+        else:
+            self.params = params
+        self.state = {str(i): l.init_state() for i, l in enumerate(self.layers)}
+        self.updater_state = self.conf.updater.init(self._trainable(self.params))
+        return self
+
+    def _trainable(self, params):
+        return params
+
+    # ------------------------------------------------------------- forward
+    def _forward(self, params, state, x, mask, *, train, rng, carry=None,
+                 upto: Optional[int] = None):
+        """Run layers [0, upto). Returns (x_out, new_states, new_carry, mask_out)."""
+        n = len(self.layers) if upto is None else upto
+        new_states = {}
+        new_carry = {}
+        cur_mask = mask
+        if rng is not None:
+            keys = jax.random.split(rng, max(n, 1))
+        for i in range(n):
+            layer = self.layers[i]
+            if i in self.conf.preprocessors:
+                x = self.conf.preprocessors[i].forward(x)
+                cur_mask = self.conf.preprocessors[i].feed_forward_mask(cur_mask)
+            layer_state = dict(state.get(str(i), {}))
+            if carry is not None and str(i) in carry:
+                layer_state.update(carry[str(i)])
+            k = keys[i] if rng is not None else None
+            x, ns = layer.forward(params[str(i)], layer_state, x, mask=cur_mask,
+                                  train=train, rng=k)
+            persistent, rnn_carry = _split_state(ns)
+            new_states[str(i)] = persistent
+            if rnn_carry:
+                new_carry[str(i)] = rnn_carry
+            cur_mask = layer.feed_forward_mask(cur_mask)
+        return x, new_states, new_carry, cur_mask
+
+    def feed_forward(self, x, train: bool = False):
+        """All layer activations (reference: MultiLayerNetwork.feedForward :753)."""
+        x = jnp.asarray(x)
+        acts = [x]
+        cur = x
+        for i, layer in enumerate(self.layers):
+            if i in self.conf.preprocessors:
+                cur = self.conf.preprocessors[i].forward(cur)
+            cur, _ = layer.forward(self.params[str(i)], self.state.get(str(i), {}),
+                                   cur, train=train)
+            acts.append(cur)
+        return acts
+
+    # --------------------------------------------------------------- loss
+    def _loss(self, params, state, x, y, input_mask, label_mask, *, train, rng,
+              carry=None):
+        out_idx = len(self.layers) - 1
+        last_in, new_states, new_carry, cur_mask = self._forward(
+            params, state, x, input_mask, train=train, rng=rng, carry=carry,
+            upto=out_idx)
+        out_layer = self.layers[out_idx]
+        if out_idx in self.conf.preprocessors:
+            last_in = self.conf.preprocessors[out_idx].forward(last_in)
+        p_out = params[str(out_idx)]
+        if isinstance(out_layer, CenterLossOutputLayer):
+            per_ex = out_layer.compute_loss_per_example(
+                p_out, last_in, y, state=state.get(str(out_idx)))
+        else:
+            per_ex = out_layer.compute_loss_per_example(p_out, last_in, y)
+        lm = label_mask if label_mask is not None else cur_mask
+        if lm is not None:
+            lm = lm.reshape(per_ex.shape).astype(per_ex.dtype)
+            data_loss = jnp.sum(per_ex * lm) / jnp.maximum(jnp.sum(lm), 1.0)
+        else:
+            data_loss = jnp.mean(per_ex)
+        reg = 0.0
+        for i, layer in enumerate(self.layers):
+            reg = reg + layer.regularization(params[str(i)])
+        new_states[str(out_idx)] = state.get(str(out_idx), {})
+        return data_loss + reg, (new_states, new_carry, last_in)
+
+    # ---------------------------------------------------------- train step
+    def _make_step(self, with_carry: bool):
+        updater = self.conf.updater
+        lr_mults = {}
+        base_lr = getattr(updater, "learning_rate", None)
+        for i, l in enumerate(self.layers):
+            lr = getattr(l, "learning_rate", None)
+            if lr is not None and base_lr:
+                lr_mults[str(i)] = lr / base_lr
+
+        def step(params, opt_state, state, rng, iteration, x, y, input_mask,
+                 label_mask, carry):
+            def loss_fn(p):
+                return self._loss(p, state, x, y, input_mask, label_mask,
+                                  train=True, rng=rng,
+                                  carry=carry if with_carry else None)
+
+            (loss, (new_states, new_carry, last_in)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            if lr_mults:
+                steps = {}
+                new_opt = {}
+                for key in params:
+                    sub_state = {slot: opt_state[slot][key] for slot in opt_state}
+                    s, ns = updater.step({key: grads[key]},
+                                         {slot: {key: sub_state[slot]} for slot in sub_state},
+                                         iteration, lr_mults.get(key, 1.0))
+                    steps[key] = s[key]
+                    for slot in ns:
+                        new_opt.setdefault(slot, {})[key] = ns[slot][key]
+                opt_state2 = new_opt
+            else:
+                steps, opt_state2 = updater.step(grads, opt_state, iteration)
+            new_params = jax.tree_util.tree_map(lambda p, s: p - s, params, steps)
+            # non-gradient center update for center loss
+            out_idx = len(self.layers) - 1
+            out_layer = self.layers[out_idx]
+            if isinstance(out_layer, CenterLossOutputLayer):
+                new_states[str(out_idx)] = out_layer.update_centers(
+                    state[str(out_idx)], last_in, y)
+            return new_params, opt_state2, new_states, new_carry, loss
+
+        return jax.jit(step)
+
+    def _get_step(self, key):
+        if key not in self._step_cache:
+            self._step_cache[key] = self._make_step(with_carry=key[-1])
+        return self._step_cache[key]
+
+    def do_step(self, x, y, input_mask=None, label_mask=None, carry=None):
+        """One SGD iteration on one minibatch; returns the minibatch loss."""
+        x = jnp.asarray(x)
+        y = jnp.asarray(y)
+        input_mask = jnp.asarray(input_mask) if input_mask is not None else None
+        label_mask = jnp.asarray(label_mask) if label_mask is not None else None
+        with_carry = carry is not None
+        key = (x.shape, y.shape, input_mask is not None, label_mask is not None,
+               with_carry)
+        step = self._get_step(key)
+        rng = jax.random.fold_in(jax.random.PRNGKey(self.conf.seed), self.iteration)
+        (self.params, self.updater_state, self.state, new_carry, loss) = step(
+            self.params, self.updater_state, self.state, rng,
+            jnp.asarray(self.iteration, jnp.float32), x, y, input_mask, label_mask,
+            carry if with_carry else {})
+        self.iteration += 1
+        self.score_value = float(loss)
+        for listener in self.listeners:
+            listener.iteration_done(self, self.iteration)
+        return self.score_value, new_carry
+
+    # ------------------------------------------------------------------ fit
+    def fit(self, data, labels=None, epochs: int = 1):
+        """Train. ``data`` may be (features, labels) arrays, a DataSet, or a
+        DataSetIterator (reference: MultiLayerNetwork.fit :1047)."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        if labels is not None:
+            data = DataSet(np.asarray(data), np.asarray(labels))
+        if isinstance(data, DataSet):
+            for _ in range(epochs):
+                self._fit_batch(data)
+            return self
+        for _ in range(epochs):
+            for listener in self.listeners:
+                listener.on_epoch_start(self)
+            if hasattr(data, "reset"):
+                data.reset()
+            for ds in data:
+                self._fit_batch(ds)
+            for listener in self.listeners:
+                listener.on_epoch_end(self)
+            self.epoch += 1
+        return self
+
+    def _fit_batch(self, ds):
+        if self.conf.backprop_type == "tbptt" and ds.features.ndim == 3:
+            self._fit_tbptt(ds)
+        else:
+            self.do_step(ds.features, ds.labels, ds.features_mask, ds.labels_mask)
+
+    def _fit_tbptt(self, ds):
+        """Truncated BPTT (reference: MultiLayerNetwork.java:1364 doTruncatedBPTT)."""
+        T = ds.features.shape[1]
+        L = self.conf.tbptt_fwd_length
+        n_seg = max(1, math.ceil(T / L))
+        carry: dict = {}
+        for s in range(n_seg):
+            sl = slice(s * L, min((s + 1) * L, T))
+            fx = ds.features[:, sl]
+            fy = ds.labels[:, sl] if ds.labels.ndim == 3 else ds.labels
+            fm = ds.features_mask[:, sl] if ds.features_mask is not None else None
+            lm = ds.labels_mask[:, sl] if ds.labels_mask is not None else None
+            _, carry = self.do_step(fx, fy, fm, lm, carry=carry)
+            carry = jax.tree_util.tree_map(jax.lax.stop_gradient, carry)
+
+    # ------------------------------------------------------------- inference
+    def output(self, x, train: bool = False):
+        """Final-layer activations (reference: MultiLayerNetwork.output :1717)."""
+        x = jnp.asarray(x)
+        key = (x.shape, train)
+        if key not in self._output_cache:
+            def fwd(params, state, xx):
+                out, _, _, _ = self._forward(params, state, xx, None, train=train,
+                                             rng=None)
+                return out
+            self._output_cache[key] = jax.jit(fwd)
+        return self._output_cache[key](self.params, self.state, x)
+
+    def score(self, ds=None, x=None, y=None) -> float:
+        """Loss (incl. regularization) on a dataset (reference: computeGradientAndScore)."""
+        if ds is not None:
+            x, y = ds.features, ds.labels
+            im, lm = ds.features_mask, ds.labels_mask
+        else:
+            im = lm = None
+        loss, _ = self._loss(self.params, self.state, jnp.asarray(x), jnp.asarray(y),
+                             None if im is None else jnp.asarray(im),
+                             None if lm is None else jnp.asarray(lm),
+                             train=False, rng=None)
+        return float(loss)
+
+    def evaluate(self, data, labels=None):
+        """Classification evaluation (reference: MultiLayerNetwork.evaluate)."""
+        from deeplearning4j_tpu.evaluation.classification import Evaluation
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        ev = Evaluation()
+        if labels is not None:
+            data = [DataSet(np.asarray(data), np.asarray(labels))]
+        elif isinstance(data, DataSet):
+            data = [data]
+        elif hasattr(data, "reset"):
+            data.reset()
+        for ds in data:
+            out = self.output(ds.features)
+            ev.eval(ds.labels, np.asarray(out), mask=ds.labels_mask)
+        return ev
+
+    # ------------------------------------------------------- rnn streaming
+    def rnn_clear_previous_state(self):
+        self._rnn_state = None
+
+    def rnn_time_step(self, x):
+        """Streaming single/multi-step inference with persistent state (reference:
+        MultiLayerNetwork.rnnTimeStep)."""
+        x = jnp.asarray(x)
+        squeeze = False
+        if x.ndim == 2:  # [B, F] -> single timestep
+            x = x[:, None, :]
+            squeeze = True
+        carry = self._rnn_state or {}
+        out, _, new_carry, _ = self._forward(self.params, self.state, x, None,
+                                             train=False, rng=None, carry=carry)
+        self._rnn_state = new_carry
+        return out[:, 0] if squeeze and out.ndim == 3 else out
+
+    # ---------------------------------------------------------- pretraining
+    def pretrain(self, data_iterator, epochs: int = 1):
+        """Layerwise unsupervised pretraining for VAE/AutoEncoder layers
+        (reference: MultiLayerNetwork.pretrain)."""
+        for i, layer in enumerate(self.layers):
+            if not hasattr(layer, "pretrain_loss_per_example") and \
+               not hasattr(layer, "reconstruction_loss_per_example"):
+                continue
+            self._pretrain_layer(i, data_iterator, epochs)
+        return self
+
+    def _pretrain_layer(self, idx, data_iterator, epochs):
+        layer = self.layers[idx]
+        updater = self.conf.updater
+        opt_state = updater.init({str(idx): self.params[str(idx)]})
+
+        @jax.jit
+        def pstep(p_layer, opt_state, all_params, rng, iteration, x):
+            feats, _, _, _ = self._forward(all_params, self.state, x, None,
+                                           train=False, rng=None, upto=idx)
+            if idx in self.conf.preprocessors:
+                feats = self.conf.preprocessors[idx].forward(feats)
+
+            def loss_fn(pl):
+                if hasattr(layer, "pretrain_loss_per_example"):
+                    per = layer.pretrain_loss_per_example(pl[str(idx)], feats, rng)
+                else:
+                    per = layer.reconstruction_loss_per_example(pl[str(idx)], feats,
+                                                                rng)
+                return jnp.mean(per)
+
+            loss, grads = jax.value_and_grad(loss_fn)(p_layer)
+            steps, new_opt = updater.step(grads, opt_state, iteration)
+            new_p = jax.tree_util.tree_map(lambda p, s: p - s, p_layer, steps)
+            return new_p, new_opt, loss
+
+        it = 0
+        for _ in range(epochs):
+            if hasattr(data_iterator, "reset"):
+                data_iterator.reset()
+            iterable = (data_iterator if not hasattr(data_iterator, "features")
+                        else [data_iterator])
+            for ds in iterable:
+                rng = jax.random.fold_in(jax.random.PRNGKey(self.conf.seed + idx), it)
+                p_layer = {str(idx): self.params[str(idx)]}
+                p_layer, opt_state, loss = pstep(
+                    p_layer, opt_state, self.params, rng,
+                    jnp.asarray(it, jnp.float32), jnp.asarray(ds.features))
+                self.params[str(idx)] = p_layer[str(idx)]
+                it += 1
+
+    # ------------------------------------------------------- params plumbing
+    def params_flat(self) -> np.ndarray:
+        """One contiguous parameter vector (reference: MultiLayerNetwork.params() /
+        flattenedParams, :103,443-493). Order: layer index, then param_order."""
+        return flatten_params(self.params, self.layers)
+
+    def set_params_flat(self, flat) -> None:
+        self.params = unflatten_params(flat, self.params, self.layers)
+
+    def num_params(self) -> int:
+        return int(sum(np.prod(v.shape) for lp in self.params.values()
+                       for v in lp.values()))
+
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self
+
+    def add_listener(self, listener):
+        self.listeners.append(listener)
+        return self
+
+    def clone(self) -> "MultiLayerNetwork":
+        import copy
+        net = MultiLayerNetwork(copy.deepcopy(self.conf))
+        net.init()
+        net.params = jax.tree_util.tree_map(lambda a: a, self.params)
+        net.state = jax.tree_util.tree_map(lambda a: a, self.state)
+        net.updater_state = jax.tree_util.tree_map(lambda a: a, self.updater_state)
+        net.iteration = self.iteration
+        return net

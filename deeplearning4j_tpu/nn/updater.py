@@ -1,0 +1,259 @@
+"""Updaters (optimizers) + learning-rate schedules.
+
+Reference: the per-layer IUpdater configs (Sgd/Adam/Nesterovs/RmsProp/AdaGrad/
+AdaDelta/Adamax/Nadam/NoOp) bridged by nn/updater/BaseMultiLayerUpdater.java into one
+contiguous state buffer applied blockwise (UpdaterBlock.java:104-134). Here updater
+state is a pytree mirroring the param pytree, and the whole update is one fused
+tree_map inside the jitted train step — the TPU equivalent of the reference's
+single-op UpdaterBlock application.
+
+Each updater computes the STEP to subtract: ``params_new = params - step``.
+Learning-rate schedules mirror nn/conf/LearningRatePolicy.java (Exponential, Inverse,
+Poly, Sigmoid, Step, Schedule map).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.utils.serde import register_serializable
+
+
+def _tree_zeros(params):
+    return jax.tree_util.tree_map(jnp.zeros_like, params)
+
+
+@register_serializable
+@dataclass
+class LearningRateSchedule:
+    """lr(iteration). policy: none|exponential|inverse|poly|sigmoid|step|schedule."""
+
+    policy: str = "none"
+    decay_rate: float = 0.0
+    power: float = 1.0
+    steps: float = 1.0
+    max_iterations: int = 10000
+    schedule: Optional[dict] = None  # {iteration(str|int): lr}
+
+    def __call__(self, base_lr, iteration):
+        it = iteration
+        p = self.policy
+        if p == "none":
+            return base_lr
+        if p == "exponential":
+            return base_lr * self.decay_rate ** it
+        if p == "inverse":
+            return base_lr / (1.0 + self.decay_rate * it) ** self.power
+        if p == "poly":
+            frac = jnp.clip(it / self.max_iterations, 0.0, 1.0)
+            return base_lr * (1.0 - frac) ** self.power
+        if p == "sigmoid":
+            return base_lr / (1.0 + jnp.exp(-self.decay_rate * (it - self.steps)))
+        if p == "step":
+            return base_lr * self.decay_rate ** jnp.floor(it / self.steps)
+        if p == "schedule":
+            # piecewise-constant: applied outside jit (python int iteration) or via
+            # nested where; schedule keys are iteration thresholds
+            lr = base_lr
+            for k in sorted(self.schedule or {}, key=lambda s: int(s)):
+                lr = jnp.where(it >= int(k), self.schedule[k], lr)
+            return lr
+        raise ValueError(f"Unknown LR policy '{p}'")
+
+
+@register_serializable
+@dataclass
+class Updater:
+    """Base updater config. State: dict of pytrees keyed by slot name."""
+
+    learning_rate: float = 0.1
+    lr_schedule: LearningRateSchedule = field(default_factory=LearningRateSchedule)
+
+    def init(self, params):
+        return {}
+
+    def lr(self, iteration):
+        return self.lr_schedule(self.learning_rate, iteration)
+
+    def step(self, grads, state, iteration, lr_mult=1.0):
+        raise NotImplementedError
+
+
+@register_serializable
+@dataclass
+class Sgd(Updater):
+    def step(self, grads, state, iteration, lr_mult=1.0):
+        lr = self.lr(iteration) * lr_mult
+        return jax.tree_util.tree_map(lambda g: lr * g, grads), state
+
+
+@register_serializable
+@dataclass
+class NoOp(Updater):
+    def step(self, grads, state, iteration, lr_mult=1.0):
+        return jax.tree_util.tree_map(jnp.zeros_like, grads), state
+
+
+@register_serializable
+@dataclass
+class Nesterovs(Updater):
+    momentum: float = 0.9
+
+    def init(self, params):
+        return {"v": _tree_zeros(params)}
+
+    def step(self, grads, state, iteration, lr_mult=1.0):
+        lr = self.lr(iteration) * lr_mult
+        mu = self.momentum
+        v_old = state["v"]
+        v_new = jax.tree_util.tree_map(lambda v, g: mu * v - lr * g, v_old, grads)
+        # param += -mu*v_old + (1+mu)*v_new  (nd4j NesterovsUpdater form)
+        steps = jax.tree_util.tree_map(lambda vo, vn: mu * vo - (1.0 + mu) * vn,
+                                       v_old, v_new)
+        return steps, {"v": v_new}
+
+
+@register_serializable
+@dataclass
+class Adam(Updater):
+    learning_rate: float = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init(self, params):
+        return {"m": _tree_zeros(params), "v": _tree_zeros(params)}
+
+    def step(self, grads, state, iteration, lr_mult=1.0):
+        lr = self.lr(iteration) * lr_mult
+        t = iteration + 1.0
+        b1, b2 = self.beta1, self.beta2
+        m = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g, state["m"], grads)
+        v = jax.tree_util.tree_map(lambda v, g: b2 * v + (1 - b2) * g * g, state["v"],
+                                   grads)
+        alpha = lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        steps = jax.tree_util.tree_map(
+            lambda m, v: alpha * m / (jnp.sqrt(v) + self.epsilon), m, v)
+        return steps, {"m": m, "v": v}
+
+
+@register_serializable
+@dataclass
+class AdaMax(Updater):
+    learning_rate: float = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init(self, params):
+        return {"m": _tree_zeros(params), "u": _tree_zeros(params)}
+
+    def step(self, grads, state, iteration, lr_mult=1.0):
+        lr = self.lr(iteration) * lr_mult
+        t = iteration + 1.0
+        b1, b2 = self.beta1, self.beta2
+        m = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g, state["m"], grads)
+        u = jax.tree_util.tree_map(lambda u, g: jnp.maximum(b2 * u, jnp.abs(g)),
+                                   state["u"], grads)
+        alpha = lr / (1 - b1 ** t)
+        steps = jax.tree_util.tree_map(lambda m, u: alpha * m / (u + self.epsilon), m, u)
+        return steps, {"m": m, "u": u}
+
+
+@register_serializable
+@dataclass
+class Nadam(Updater):
+    learning_rate: float = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init(self, params):
+        return {"m": _tree_zeros(params), "v": _tree_zeros(params)}
+
+    def step(self, grads, state, iteration, lr_mult=1.0):
+        lr = self.lr(iteration) * lr_mult
+        t = iteration + 1.0
+        b1, b2 = self.beta1, self.beta2
+        m = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g, state["m"], grads)
+        v = jax.tree_util.tree_map(lambda v, g: b2 * v + (1 - b2) * g * g, state["v"],
+                                   grads)
+        steps = jax.tree_util.tree_map(
+            lambda m, v, g: lr / (jnp.sqrt(v / (1 - b2 ** t)) + self.epsilon)
+            * (b1 * m / (1 - b1 ** (t + 1)) + (1 - b1) * g / (1 - b1 ** t)),
+            m, v, grads)
+        return steps, {"m": m, "v": v}
+
+
+@register_serializable
+@dataclass
+class AdaGrad(Updater):
+    epsilon: float = 1e-6
+
+    def init(self, params):
+        return {"h": _tree_zeros(params)}
+
+    def step(self, grads, state, iteration, lr_mult=1.0):
+        lr = self.lr(iteration) * lr_mult
+        h = jax.tree_util.tree_map(lambda h, g: h + g * g, state["h"], grads)
+        steps = jax.tree_util.tree_map(
+            lambda h, g: lr * g / (jnp.sqrt(h) + self.epsilon), h, grads)
+        return steps, {"h": h}
+
+
+@register_serializable
+@dataclass
+class RmsProp(Updater):
+    rms_decay: float = 0.95
+    epsilon: float = 1e-8
+
+    def init(self, params):
+        return {"h": _tree_zeros(params)}
+
+    def step(self, grads, state, iteration, lr_mult=1.0):
+        lr = self.lr(iteration) * lr_mult
+        d = self.rms_decay
+        h = jax.tree_util.tree_map(lambda h, g: d * h + (1 - d) * g * g, state["h"],
+                                   grads)
+        steps = jax.tree_util.tree_map(
+            lambda h, g: lr * g / (jnp.sqrt(h + self.epsilon)), h, grads)
+        return steps, {"h": h}
+
+
+@register_serializable
+@dataclass
+class AdaDelta(Updater):
+    rho: float = 0.95
+    epsilon: float = 1e-6
+
+    def init(self, params):
+        return {"eg": _tree_zeros(params), "ex": _tree_zeros(params)}
+
+    def step(self, grads, state, iteration, lr_mult=1.0):
+        rho, eps = self.rho, self.epsilon
+        eg = jax.tree_util.tree_map(lambda e, g: rho * e + (1 - rho) * g * g,
+                                    state["eg"], grads)
+        dx = jax.tree_util.tree_map(
+            lambda g, e, x: g * jnp.sqrt(x + eps) / jnp.sqrt(e + eps),
+            grads, eg, state["ex"])
+        ex = jax.tree_util.tree_map(lambda x, d: rho * x + (1 - rho) * d * d,
+                                    state["ex"], dx)
+        return dx, {"eg": eg, "ex": ex}
+
+
+_BY_NAME = {"sgd": Sgd, "adam": Adam, "adamax": AdaMax, "nadam": Nadam,
+            "nesterovs": Nesterovs, "adagrad": AdaGrad, "rmsprop": RmsProp,
+            "adadelta": AdaDelta, "none": NoOp, "noop": NoOp}
+
+
+def get_updater(u, learning_rate=None) -> Updater:
+    if isinstance(u, Updater):
+        return u
+    cls = _BY_NAME.get(str(u).lower())
+    if cls is None:
+        raise ValueError(f"Unknown updater '{u}'. Known: {sorted(_BY_NAME)}")
+    return cls(learning_rate=learning_rate) if learning_rate is not None else cls()

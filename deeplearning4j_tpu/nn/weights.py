@@ -1,0 +1,95 @@
+"""Weight initialisation schemes.
+
+Parity with the reference's ``WeightInit`` enum + ``WeightInitUtil``
+(deeplearning4j-nn/.../nn/weights/WeightInit.java, WeightInitUtil.java): XAVIER,
+XAVIER_UNIFORM, XAVIER_FAN_IN, RELU, RELU_UNIFORM, UNIFORM, SIGMOID_UNIFORM,
+LECUN_NORMAL/UNIFORM, ZERO, ONES, IDENTITY, DISTRIBUTION, NORMAL.
+
+All initialisers are pure functions of a jax PRNG key.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class Distribution:
+    """Config for WeightInit.DISTRIBUTION (reference: nn/conf/distribution/*)."""
+
+    kind: str = "normal"  # normal | uniform | binomial(unsupported->normal)
+    mean: float = 0.0
+    std: float = 1.0
+    lower: float = -1.0
+    upper: float = 1.0
+
+    def sample(self, rng, shape, dtype=jnp.float32):
+        if self.kind == "uniform":
+            return jax.random.uniform(rng, shape, dtype, self.lower, self.upper)
+        return self.mean + self.std * jax.random.normal(rng, shape, dtype)
+
+    def to_dict(self):
+        return {"kind": self.kind, "mean": self.mean, "std": self.std,
+                "lower": self.lower, "upper": self.upper}
+
+    @staticmethod
+    def from_dict(d):
+        return Distribution(**d)
+
+    @staticmethod
+    def normal(mean=0.0, std=1.0):
+        return Distribution(kind="normal", mean=mean, std=std)
+
+    @staticmethod
+    def uniform(lower=-1.0, upper=1.0):
+        return Distribution(kind="uniform", lower=lower, upper=upper)
+
+
+def init_weight(rng, shape, fan_in: float, fan_out: float, scheme: str = "xavier",
+                distribution: Optional[Distribution] = None, dtype=jnp.float32):
+    """Initialise a weight array. Formulas match WeightInitUtil of the reference."""
+    scheme = str(scheme).lower()
+    if scheme == "zero":
+        return jnp.zeros(shape, dtype)
+    if scheme == "ones":
+        return jnp.ones(shape, dtype)
+    if scheme == "identity":
+        if len(shape) != 2 or shape[0] != shape[1]:
+            raise ValueError("IDENTITY weight init requires square 2-D shape")
+        return jnp.eye(shape[0], dtype=dtype)
+    if scheme == "distribution":
+        dist = distribution or Distribution()
+        return dist.sample(rng, shape, dtype)
+    if scheme == "normal":
+        return jax.random.normal(rng, shape, dtype) / math.sqrt(max(fan_in, 1.0))
+    if scheme == "lecun_normal":
+        return jax.random.normal(rng, shape, dtype) * math.sqrt(1.0 / max(fan_in, 1.0))
+    if scheme == "xavier":
+        return jax.random.normal(rng, shape, dtype) * math.sqrt(2.0 / (fan_in + fan_out))
+    if scheme == "xavier_fan_in":
+        return jax.random.normal(rng, shape, dtype) / math.sqrt(max(fan_in, 1.0))
+    if scheme == "xavier_legacy":
+        return jax.random.normal(rng, shape, dtype) / math.sqrt(shape[0] + shape[-1])
+    if scheme == "xavier_uniform":
+        s = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, dtype, -s, s)
+    if scheme == "relu":
+        return jax.random.normal(rng, shape, dtype) * math.sqrt(2.0 / max(fan_in, 1.0))
+    if scheme == "relu_uniform":
+        s = math.sqrt(6.0 / max(fan_in, 1.0))
+        return jax.random.uniform(rng, shape, dtype, -s, s)
+    if scheme == "uniform":
+        a = 1.0 / math.sqrt(max(fan_in, 1.0))
+        return jax.random.uniform(rng, shape, dtype, -a, a)
+    if scheme == "sigmoid_uniform":
+        s = 4.0 * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, dtype, -s, s)
+    if scheme == "lecun_uniform":
+        s = math.sqrt(3.0 / max(fan_in, 1.0))
+        return jax.random.uniform(rng, shape, dtype, -s, s)
+    raise ValueError(f"Unknown weight init scheme '{scheme}'")

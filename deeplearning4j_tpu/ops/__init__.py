@@ -1,0 +1,9 @@
+"""Tensor op facade: the measured ND4J op surface re-expressed over jax.numpy/lax.
+
+Covers the op families DL4J actually calls into ND4J for (SURVEY.md section 2.2):
+gemm, conv (im2col-free via lax.conv_general_dilated), pooling (lax.reduce_window),
+elementwise transforms, RNG, argmax/gather, and activation/loss function objects.
+"""
+
+from deeplearning4j_tpu.ops.activations import Activation, get_activation
+from deeplearning4j_tpu.ops.losses import LossFunction, get_loss

@@ -1,0 +1,94 @@
+"""Activation functions (parity with ND4J IActivation set used by DL4J layer configs).
+
+Reference surface: DL4J's ``Activation`` enum (nd4j IActivation impls) referenced from
+layer builders, e.g. ``nn/conf/layers/Layer.java`` activation field. Each activation
+here is a pure jax function; gradients come from jax autodiff rather than the
+hand-written ``backprop(in, epsilon)`` of the reference.
+
+All functions operate elementwise on arrays of any shape except ``softmax`` which
+normalises over the last axis (the feature axis in our NHWC / [batch, time, feature]
+layouts).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_REGISTRY: dict[str, "Activation"] = {}
+
+
+class Activation:
+    """A named activation function. Callable; serialises to its name."""
+
+    def __init__(self, name: str, fn):
+        self.name = name
+        self._fn = fn
+
+    def __call__(self, x):
+        return self._fn(x)
+
+    def __repr__(self):  # pragma: no cover
+        return f"Activation({self.name})"
+
+    def __eq__(self, other):
+        return isinstance(other, Activation) and other.name == self.name
+
+    def __hash__(self):
+        return hash(self.name)
+
+
+def _register(name: str, fn) -> Activation:
+    act = Activation(name, fn)
+    _REGISTRY[name] = act
+    return act
+
+
+def get_activation(name) -> Activation:
+    """Resolve an activation by name (case-insensitive) or pass through an Activation."""
+    if isinstance(name, Activation):
+        return name
+    key = str(name).lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"Unknown activation '{name}'. Known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key]
+
+
+def _hardtanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+def _hardsigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def _rationaltanh(x):
+    # tanh approximation: 1.7159 * tanh(2x/3) as used by the reference's RationalTanh
+    return 1.7159 * jnp.tanh(2.0 * x / 3.0)
+
+
+def _rectifiedtanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+IDENTITY = _register("identity", lambda x: x)
+LINEAR = _REGISTRY["identity"]
+_register("linear", lambda x: x)
+RELU = _register("relu", jax.nn.relu)
+RELU6 = _register("relu6", lambda x: jnp.clip(x, 0.0, 6.0))
+LEAKYRELU = _register("leakyrelu", lambda x: jax.nn.leaky_relu(x, 0.01))
+TANH = _register("tanh", jnp.tanh)
+SIGMOID = _register("sigmoid", jax.nn.sigmoid)
+SOFTMAX = _register("softmax", lambda x: jax.nn.softmax(x, axis=-1))
+SOFTPLUS = _register("softplus", jax.nn.softplus)
+SOFTSIGN = _register("softsign", jax.nn.soft_sign)
+ELU = _register("elu", jax.nn.elu)
+SELU = _register("selu", jax.nn.selu)
+GELU = _register("gelu", jax.nn.gelu)
+SILU = _register("silu", jax.nn.silu)
+SWISH = _register("swish", jax.nn.silu)
+CUBE = _register("cube", lambda x: x ** 3)
+HARDTANH = _register("hardtanh", _hardtanh)
+HARDSIGMOID = _register("hardsigmoid", _hardsigmoid)
+RATIONALTANH = _register("rationaltanh", _rationaltanh)
+RECTIFIEDTANH = _register("rectifiedtanh", _rectifiedtanh)

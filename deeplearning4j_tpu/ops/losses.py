@@ -1,0 +1,187 @@
+"""Loss functions (parity with ND4J ILossFunction set used by DL4J output layers).
+
+Reference surface: the ``LossFunctions.LossFunction`` enum consumed by
+``nn/conf/layers/OutputLayer``/``RnnOutputLayer``/``LossLayer`` builders. Semantics
+follow the reference: per-example loss is the SUM over output units; the reported
+score is the MEAN over (unmasked) examples. Masks (per-example or per-timestep) zero
+out contributions and are excluded from the mean denominator.
+
+Each loss takes ``(labels, preactivations, activation, mask)`` and exposes:
+- ``score(...)``     -> scalar mean loss
+- ``score_per_example(...)`` -> [batch] (or [batch*time]) vector
+
+Losses are computed from *pre-activations* plus the output activation function so
+that numerically-fused forms (softmax+xent, sigmoid+bce) can be used, mirroring how
+the reference fuses ``LossMCXENT`` with softmax output.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.activations import Activation, get_activation
+
+_EPS = 1e-7
+
+_REGISTRY: dict[str, "LossFunction"] = {}
+
+
+class LossFunction:
+    """A named loss. ``per_example(labels, preact, activation)`` -> [batch] losses."""
+
+    def __init__(self, name: str, fn, *, probs_fn=None):
+        self.name = name
+        # fn(labels, preact, activation_obj) -> per-example loss, reduced over features
+        self._fn = fn
+
+    def per_example(self, labels, preact, activation: Activation, weights=None):
+        return self._fn(labels, preact, activation, weights)
+
+    def score(self, labels, preact, activation: Activation, mask=None, weights=None):
+        """Mean-over-examples loss, matching DL4J's computeScore(average=true)."""
+        per_ex = self.per_example(labels, preact, activation, weights)
+        if mask is not None:
+            mask = mask.reshape(per_ex.shape).astype(per_ex.dtype)
+            total = jnp.sum(per_ex * mask)
+            denom = jnp.maximum(jnp.sum(mask), 1.0)
+            return total / denom
+        return jnp.mean(per_ex)
+
+    def __repr__(self):  # pragma: no cover
+        return f"LossFunction({self.name})"
+
+    def __eq__(self, other):
+        return isinstance(other, LossFunction) and other.name == self.name
+
+    def __hash__(self):
+        return hash(self.name)
+
+
+def _register(name: str, fn) -> LossFunction:
+    loss = LossFunction(name, fn)
+    _REGISTRY[name] = loss
+    return loss
+
+
+def get_loss(name) -> LossFunction:
+    if isinstance(name, LossFunction):
+        return name
+    key = str(name).lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"Unknown loss '{name}'. Known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key]
+
+
+def _apply_weights(per_feature, weights):
+    if weights is not None:
+        per_feature = per_feature * weights
+    return per_feature
+
+
+def _mcxent(labels, preact, activation, weights):
+    """Multi-class cross entropy. Fused log-softmax path when output act is softmax."""
+    if activation.name == "softmax":
+        logp = jax.nn.log_softmax(preact, axis=-1)
+    else:
+        p = jnp.clip(activation(preact), _EPS, 1.0 - _EPS)
+        logp = jnp.log(p)
+    return -jnp.sum(_apply_weights(labels * logp, weights), axis=-1)
+
+
+def _xent(labels, preact, activation, weights):
+    """Binary cross entropy (per-unit), fused with sigmoid when applicable."""
+    if activation.name == "sigmoid":
+        # stable: max(x,0) - x*z + log(1+exp(-|x|))
+        x = preact
+        per = jnp.maximum(x, 0.0) - x * labels + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    else:
+        p = jnp.clip(activation(preact), _EPS, 1.0 - _EPS)
+        per = -(labels * jnp.log(p) + (1.0 - labels) * jnp.log(1.0 - p))
+    return jnp.sum(_apply_weights(per, weights), axis=-1)
+
+
+def _mse(labels, preact, activation, weights):
+    out = activation(preact)
+    return jnp.sum(_apply_weights((labels - out) ** 2, weights), axis=-1) / labels.shape[-1]
+
+
+def _sse(labels, preact, activation, weights):
+    out = activation(preact)
+    return jnp.sum(_apply_weights((labels - out) ** 2, weights), axis=-1)
+
+
+def _mae(labels, preact, activation, weights):
+    out = activation(preact)
+    return jnp.sum(_apply_weights(jnp.abs(labels - out), weights), axis=-1) / labels.shape[-1]
+
+
+def _l1(labels, preact, activation, weights):
+    out = activation(preact)
+    return jnp.sum(_apply_weights(jnp.abs(labels - out), weights), axis=-1)
+
+
+def _mape(labels, preact, activation, weights):
+    out = activation(preact)
+    per = jnp.abs((labels - out) / jnp.where(jnp.abs(labels) < _EPS, _EPS, labels)) * 100.0
+    return jnp.sum(_apply_weights(per, weights), axis=-1) / labels.shape[-1]
+
+
+def _msle(labels, preact, activation, weights):
+    out = activation(preact)
+    per = (jnp.log1p(jnp.maximum(out, -1.0 + _EPS)) - jnp.log1p(jnp.maximum(labels, -1.0 + _EPS))) ** 2
+    return jnp.sum(_apply_weights(per, weights), axis=-1) / labels.shape[-1]
+
+
+def _kld(labels, preact, activation, weights):
+    out = jnp.clip(activation(preact), _EPS, 1.0)
+    lab = jnp.clip(labels, _EPS, 1.0)
+    per = lab * (jnp.log(lab) - jnp.log(out))
+    return jnp.sum(_apply_weights(per, weights), axis=-1)
+
+
+def _nll(labels, preact, activation, weights):
+    # DL4J aliases NEGATIVELOGLIKELIHOOD to MCXENT
+    return _mcxent(labels, preact, activation, weights)
+
+
+def _poisson(labels, preact, activation, weights):
+    out = jnp.maximum(activation(preact), _EPS)
+    per = out - labels * jnp.log(out)
+    return jnp.sum(_apply_weights(per, weights), axis=-1)
+
+
+def _cosine(labels, preact, activation, weights):
+    out = activation(preact)
+    dot = jnp.sum(out * labels, axis=-1)
+    norm = jnp.linalg.norm(out, axis=-1) * jnp.linalg.norm(labels, axis=-1)
+    return 1.0 - dot / jnp.maximum(norm, _EPS)
+
+
+def _hinge(labels, preact, activation, weights):
+    # labels in {-1, +1}
+    out = activation(preact)
+    return jnp.sum(_apply_weights(jnp.maximum(0.0, 1.0 - labels * out), weights), axis=-1)
+
+
+def _squared_hinge(labels, preact, activation, weights):
+    out = activation(preact)
+    return jnp.sum(_apply_weights(jnp.maximum(0.0, 1.0 - labels * out) ** 2, weights), axis=-1)
+
+
+MCXENT = _register("mcxent", _mcxent)
+NEGATIVELOGLIKELIHOOD = _register("negativeloglikelihood", _nll)
+XENT = _register("xent", _xent)
+MSE = _register("mse", _mse)
+SQUARED_LOSS = _register("squared_loss", _sse)
+MEAN_ABSOLUTE_ERROR = _register("mean_absolute_error", _mae)
+L1 = _register("l1", _l1)
+L2 = _register("l2", _sse)
+MEAN_ABSOLUTE_PERCENTAGE_ERROR = _register("mean_absolute_percentage_error", _mape)
+MEAN_SQUARED_LOGARITHMIC_ERROR = _register("mean_squared_logarithmic_error", _msle)
+KL_DIVERGENCE = _register("kl_divergence", _kld)
+RECONSTRUCTION_CROSSENTROPY = _register("reconstruction_crossentropy", _xent)
+POISSON = _register("poisson", _poisson)
+COSINE_PROXIMITY = _register("cosine_proximity", _cosine)
+HINGE = _register("hinge", _hinge)
+SQUARED_HINGE = _register("squared_hinge", _squared_hinge)

@@ -1,0 +1,10 @@
+"""Optimization: training listeners, solvers, gradient accumulation."""
+
+from deeplearning4j_tpu.optimize.listeners import (
+    TrainingListener,
+    ScoreIterationListener,
+    PerformanceListener,
+    CollectScoresIterationListener,
+    EvaluativeListener,
+    TimeIterationListener,
+)

@@ -1,0 +1,131 @@
+"""Training listeners.
+
+Reference: optimize/api/IterationListener.java + TrainingListener.java (hooks fired
+by the optimizer, e.g. ComputationGraph.java:1192-1235) and the impls under
+optimize/listeners/ (ScoreIterationListener, PerformanceListener, EvaluativeListener,
+CollectScoresIterationListener, TimeIterationListener, ModelSavingCallback).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+
+class TrainingListener:
+    """Base listener. Subclasses override any subset of hooks."""
+
+    def iteration_done(self, model, iteration: int):
+        pass
+
+    def on_epoch_start(self, model):
+        pass
+
+    def on_epoch_end(self, model):
+        pass
+
+
+class ScoreIterationListener(TrainingListener):
+    """Log score every N iterations (reference: ScoreIterationListener)."""
+
+    def __init__(self, print_iterations: int = 10):
+        self.print_iterations = max(1, print_iterations)
+
+    def iteration_done(self, model, iteration: int):
+        if iteration % self.print_iterations == 0:
+            log.info("Score at iteration %d is %s", iteration, model.score_value)
+
+
+class PerformanceListener(TrainingListener):
+    """Throughput (examples/sec, iterations/sec) every N iterations (reference:
+    optimize/listeners/PerformanceListener.java)."""
+
+    def __init__(self, frequency: int = 10, report_batch: bool = True):
+        self.frequency = max(1, frequency)
+        self.report_batch = report_batch
+        self._last_time: Optional[float] = None
+        self._last_iter = 0
+        self.last_samples_per_sec: Optional[float] = None
+        self.batch_size: int = 0
+
+    def iteration_done(self, model, iteration: int):
+        now = time.perf_counter()
+        if self._last_time is not None and iteration % self.frequency == 0:
+            dt = now - self._last_time
+            iters = iteration - self._last_iter
+            if dt > 0 and iters > 0:
+                it_per_sec = iters / dt
+                self.last_samples_per_sec = it_per_sec * self.batch_size
+                log.info("iteration %d: %.1f iter/s, %.1f samples/s", iteration,
+                         it_per_sec, self.last_samples_per_sec or 0.0)
+            self._last_time = now
+            self._last_iter = iteration
+        elif self._last_time is None:
+            self._last_time = now
+            self._last_iter = iteration
+
+
+class CollectScoresIterationListener(TrainingListener):
+    """Collect (iteration, score) pairs (reference: CollectScoresIterationListener)."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, frequency)
+        self.scores: list = []
+
+    def iteration_done(self, model, iteration: int):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, model.score_value))
+
+
+class EvaluativeListener(TrainingListener):
+    """Periodically evaluate on a held-out iterator (reference: EvaluativeListener)."""
+
+    def __init__(self, eval_iterator, frequency: int = 100, callback=None):
+        self.eval_iterator = eval_iterator
+        self.frequency = max(1, frequency)
+        self.callback = callback
+        self.evaluations: list = []
+
+    def iteration_done(self, model, iteration: int):
+        if iteration % self.frequency == 0:
+            ev = model.evaluate(self.eval_iterator)
+            self.evaluations.append((iteration, ev))
+            if self.callback:
+                self.callback(model, ev)
+            else:
+                log.info("Eval at iter %d: accuracy=%.4f f1=%.4f", iteration,
+                         ev.accuracy(), ev.f1())
+
+
+class TimeIterationListener(TrainingListener):
+    """Estimate remaining time (reference: TimeIterationListener)."""
+
+    def __init__(self, total_iterations: int, frequency: int = 50):
+        self.total_iterations = total_iterations
+        self.frequency = max(1, frequency)
+        self._start = time.perf_counter()
+
+    def iteration_done(self, model, iteration: int):
+        if iteration % self.frequency == 0 and iteration > 0:
+            elapsed = time.perf_counter() - self._start
+            rate = elapsed / iteration
+            remaining = (self.total_iterations - iteration) * rate
+            log.info("iteration %d/%d, ~%.0fs remaining", iteration,
+                     self.total_iterations, remaining)
+
+
+class ModelSavingCallback(TrainingListener):
+    """Save checkpoints every N iterations (reference:
+    optimize/listeners/callbacks/ModelSavingCallback.java)."""
+
+    def __init__(self, path_template: str, frequency: int = 1000):
+        self.path_template = path_template
+        self.frequency = max(1, frequency)
+
+    def iteration_done(self, model, iteration: int):
+        if iteration % self.frequency == 0:
+            from deeplearning4j_tpu.utils.model_serializer import save_model
+            save_model(model, self.path_template.format(iteration=iteration))

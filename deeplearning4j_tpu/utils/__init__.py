@@ -1,0 +1,1 @@
+"""Utilities: JSON serde registry, pytree/flat-param helpers, model serialization."""

@@ -1,0 +1,79 @@
+"""JSON serde for config objects.
+
+The reference serialises configs as Jackson polymorphic JSON with ``@class`` style
+type tags (nn/conf/MultiLayerConfiguration.java ``toJson``/``fromJson``). We mirror
+that contract: every config dataclass registers here and round-trips through plain
+dicts tagged with ``"@class"``. Model zips then store ``configuration.json`` +
+``coefficients.bin`` exactly like ModelSerializer (util/ModelSerializer.java:81-119).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any
+
+_CLASSES: dict[str, type] = {}
+
+
+def register_serializable(cls):
+    """Class decorator: register a dataclass for tagged JSON round-tripping."""
+    _CLASSES[cls.__name__] = cls
+    return cls
+
+
+def to_jsonable(obj: Any) -> Any:
+    from deeplearning4j_tpu.ops.activations import Activation
+    from deeplearning4j_tpu.ops.losses import LossFunction
+
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, Activation):
+        return {"@activation": obj.name}
+    if isinstance(obj, LossFunction):
+        return {"@loss": obj.name}
+    if isinstance(obj, (list, tuple)):
+        return [to_jsonable(x) for x in obj]
+    if isinstance(obj, dict):
+        return {str(k): to_jsonable(v) for k, v in obj.items()}
+    if dataclasses.is_dataclass(obj):
+        d = {"@class": type(obj).__name__}
+        for f in dataclasses.fields(obj):
+            d[f.name] = to_jsonable(getattr(obj, f.name))
+        return d
+    if hasattr(obj, "tolist"):  # numpy / jax scalars & arrays
+        return obj.tolist()
+    raise TypeError(f"Cannot serialise {type(obj)!r} to JSON")
+
+
+def from_jsonable(d: Any) -> Any:
+    from deeplearning4j_tpu.ops.activations import get_activation
+    from deeplearning4j_tpu.ops.losses import get_loss
+
+    if isinstance(d, list):
+        return [from_jsonable(x) for x in d]
+    if isinstance(d, dict):
+        if "@activation" in d:
+            return get_activation(d["@activation"])
+        if "@loss" in d:
+            return get_loss(d["@loss"])
+        if "@class" in d:
+            name = d["@class"]
+            if name not in _CLASSES:
+                raise ValueError(f"Unknown config class '{name}' in JSON")
+            cls = _CLASSES[name]
+            field_names = {f.name for f in dataclasses.fields(cls)}
+            kwargs = {k: from_jsonable(v) for k, v in d.items()
+                      if k != "@class" and k in field_names}
+            obj = cls(**kwargs)
+            return obj
+        return {k: from_jsonable(v) for k, v in d.items()}
+    return d
+
+
+def to_json(obj: Any, indent=2) -> str:
+    return json.dumps(to_jsonable(obj), indent=indent)
+
+
+def from_json(s: str) -> Any:
+    return from_jsonable(json.loads(s))

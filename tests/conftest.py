@@ -1,0 +1,17 @@
+"""Test config: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's backend-swap test strategy (Maven profile test-nd4j-native
+vs test-nd4j-cuda, pom.xml:313-356): the same suite runs clusterless on CPU; the
+driver separately validates the real-TPU path. Distributed tests see 8 XLA host
+devices (the local[N] / BaseSparkTest equivalent).
+
+Note: jax may already be imported at interpreter startup (site hooks registering a
+TPU plugin), so the platform must be forced via jax.config, not env vars — config
+updates take effect because no backend has been initialised yet when conftest runs.
+"""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,203 @@
+"""Finite-difference gradient checks (parity with the reference's
+gradientcheck/ test suite: GradientCheckTests, CNNGradientCheckTest,
+LSTMGradientCheckTests, BNGradientCheckTest, GlobalPoolingGradientCheckTests,
+VaeGradientCheckTests, GradientCheckTestsMasking). Tiny nets, float64, smooth
+activations (tanh/softplus) per the reference's activation whitelist
+(GradientCheckUtil.java:50-59)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.gradientcheck import check_gradients
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    ActivationLayer,
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    EmbeddingLayer,
+    GlobalPoolingLayer,
+    GravesLSTM,
+    GravesBidirectionalLSTM,
+    LocalResponseNormalization,
+    LSTM,
+    OutputLayer,
+    RnnOutputLayer,
+    SimpleRnn,
+    SubsamplingLayer,
+    ZeroPaddingLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Sgd
+
+
+def _build(layers, input_type, seed=42, l1=0.0, l2=0.0):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed)
+            .updater(Sgd(learning_rate=0.1))
+            .weight_init("xavier")
+            .dtype("float64")
+            .l1(l1).l2(l2)
+            .list(*layers)
+            .set_input_type(input_type)
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _onehot(rng, n, c):
+    return np.eye(c)[rng.integers(0, c, n)]
+
+
+def test_mlp_gradients():
+    rng = np.random.default_rng(0)
+    net = _build([DenseLayer(n_out=6, activation="tanh"),
+                  DenseLayer(n_out=5, activation="softplus"),
+                  OutputLayer(n_out=3, loss="mcxent", activation="softmax")],
+                 InputType.feed_forward(4))
+    x = rng.normal(0, 1, (5, 4))
+    y = _onehot(rng, 5, 3)
+    assert check_gradients(net, x, y, verbose=True)
+
+
+def test_mlp_gradients_with_l1_l2():
+    rng = np.random.default_rng(1)
+    net = _build([DenseLayer(n_out=6, activation="tanh"),
+                  OutputLayer(n_out=3, loss="mcxent", activation="softmax")],
+                 InputType.feed_forward(4), l1=0.01, l2=0.02)
+    x = rng.normal(0, 1, (5, 4))
+    y = _onehot(rng, 5, 3)
+    assert check_gradients(net, x, y, verbose=True)
+
+
+@pytest.mark.parametrize("loss,act", [("mse", "identity"), ("xent", "sigmoid"),
+                                      ("mean_absolute_error", "tanh"),
+                                      ("negativeloglikelihood", "softmax")])
+def test_loss_function_gradients(loss, act):
+    rng = np.random.default_rng(2)
+    net = _build([DenseLayer(n_out=5, activation="tanh"),
+                  OutputLayer(n_out=3, loss=loss, activation=act)],
+                 InputType.feed_forward(4))
+    x = rng.normal(0, 1, (4, 4))
+    if loss == "xent":
+        y = (rng.random((4, 3)) > 0.5).astype(float)
+    elif act == "softmax":
+        y = _onehot(rng, 4, 3)
+    else:
+        y = rng.normal(0, 1, (4, 3))
+    assert check_gradients(net, x, y, verbose=True)
+
+
+def test_cnn_gradients():
+    rng = np.random.default_rng(3)
+    net = _build([ConvolutionLayer(n_out=3, kernel_size=(2, 2), stride=(1, 1),
+                                   activation="tanh"),
+                  SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                                   stride=(2, 2)),
+                  OutputLayer(n_out=2, loss="mcxent", activation="softmax")],
+                 InputType.convolutional(6, 6, 2))
+    x = rng.normal(0, 1, (3, 6, 6, 2))
+    y = _onehot(rng, 3, 2)
+    assert check_gradients(net, x, y, verbose=True)
+
+
+def test_cnn_avg_pool_same_mode_gradients():
+    rng = np.random.default_rng(4)
+    net = _build([ConvolutionLayer(n_out=2, kernel_size=(3, 3), stride=(1, 1),
+                                   convolution_mode="same", activation="softplus"),
+                  SubsamplingLayer(pooling_type="avg", kernel_size=(2, 2),
+                                   stride=(2, 2), convolution_mode="same"),
+                  ZeroPaddingLayer(pad_top=1, pad_bottom=1, pad_left=1, pad_right=1),
+                  OutputLayer(n_out=2, loss="mcxent", activation="softmax")],
+                 InputType.convolutional(5, 5, 1))
+    x = rng.normal(0, 1, (3, 5, 5, 1))
+    y = _onehot(rng, 3, 2)
+    assert check_gradients(net, x, y, verbose=True)
+
+
+def test_batchnorm_gradients():
+    rng = np.random.default_rng(5)
+    net = _build([DenseLayer(n_out=5, activation="tanh"),
+                  BatchNormalization(),
+                  OutputLayer(n_out=3, loss="mcxent", activation="softmax")],
+                 InputType.feed_forward(4))
+    x = rng.normal(0, 1, (6, 4))
+    y = _onehot(rng, 6, 3)
+    assert check_gradients(net, x, y, verbose=True)
+
+
+def test_lrn_gradients():
+    rng = np.random.default_rng(6)
+    net = _build([ConvolutionLayer(n_out=4, kernel_size=(2, 2), activation="tanh"),
+                  LocalResponseNormalization(),
+                  OutputLayer(n_out=2, loss="mcxent", activation="softmax")],
+                 InputType.convolutional(4, 4, 1))
+    x = rng.normal(0, 1, (2, 4, 4, 1))
+    y = _onehot(rng, 2, 2)
+    assert check_gradients(net, x, y, verbose=True)
+
+
+@pytest.mark.parametrize("layer_cls", [LSTM, GravesLSTM, SimpleRnn])
+def test_rnn_gradients(layer_cls):
+    rng = np.random.default_rng(7)
+    net = _build([layer_cls(n_out=4, activation="tanh"),
+                  RnnOutputLayer(n_out=3, loss="mcxent", activation="softmax")],
+                 InputType.recurrent(3))
+    x = rng.normal(0, 1, (2, 5, 3))
+    y = np.eye(3)[rng.integers(0, 3, (2, 5))]
+    assert check_gradients(net, x, y, verbose=True)
+
+
+def test_bidirectional_lstm_gradients():
+    rng = np.random.default_rng(8)
+    net = _build([GravesBidirectionalLSTM(n_out=3, activation="tanh"),
+                  RnnOutputLayer(n_out=2, loss="mcxent", activation="softmax")],
+                 InputType.recurrent(3))
+    x = rng.normal(0, 1, (2, 4, 3))
+    y = np.eye(2)[rng.integers(0, 2, (2, 4))]
+    assert check_gradients(net, x, y, verbose=True)
+
+
+def test_lstm_masking_gradients():
+    """Masked timesteps must contribute zero gradient (GradientCheckTestsMasking)."""
+    rng = np.random.default_rng(9)
+    net = _build([GravesLSTM(n_out=4, activation="tanh"),
+                  RnnOutputLayer(n_out=2, loss="mcxent", activation="softmax")],
+                 InputType.recurrent(3))
+    x = rng.normal(0, 1, (3, 5, 3))
+    y = np.eye(2)[rng.integers(0, 2, (3, 5))]
+    mask = np.array([[1, 1, 1, 1, 1], [1, 1, 1, 0, 0], [1, 0, 0, 0, 0]], float)
+    assert check_gradients(net, x, y, input_mask=mask, label_mask=mask, verbose=True)
+
+
+def test_global_pooling_gradients():
+    rng = np.random.default_rng(10)
+    net = _build([GravesLSTM(n_out=4, activation="tanh"),
+                  GlobalPoolingLayer(pooling_type="avg"),
+                  OutputLayer(n_out=2, loss="mcxent", activation="softmax")],
+                 InputType.recurrent(3))
+    x = rng.normal(0, 1, (2, 4, 3))
+    y = _onehot(rng, 2, 2)
+    assert check_gradients(net, x, y, verbose=True)
+
+
+def test_cnn_global_pooling_gradients():
+    rng = np.random.default_rng(11)
+    net = _build([ConvolutionLayer(n_out=3, kernel_size=(2, 2), activation="tanh"),
+                  GlobalPoolingLayer(pooling_type="max"),
+                  OutputLayer(n_out=2, loss="mcxent", activation="softmax")],
+                 InputType.convolutional(5, 5, 1))
+    x = rng.normal(0, 1, (2, 5, 5, 1))
+    y = _onehot(rng, 2, 2)
+    assert check_gradients(net, x, y, verbose=True)
+
+
+def test_embedding_gradients():
+    rng = np.random.default_rng(12)
+    net = _build([EmbeddingLayer(n_in=7, n_out=4, activation="tanh"),
+                  OutputLayer(n_in=4, n_out=3, loss="mcxent", activation="softmax")],
+                 None)
+    # embedding takes int indices; no input_type, so nIn is set explicitly
+    x = rng.integers(0, 7, (5, 1)).astype(float)
+    y = _onehot(rng, 5, 3)
+    assert check_gradients(net, x, y, verbose=True)

@@ -1,0 +1,93 @@
+"""End-to-end smoke: build, train, evaluate, serialise (the stage-2 de-risking slice)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Adam
+from deeplearning4j_tpu.datasets.mnist import IrisDataSetIterator
+from deeplearning4j_tpu.utils.model_serializer import load_model, save_model
+
+
+def _iris_net(seed=12345):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed)
+            .updater(Adam(learning_rate=0.02))
+            .weight_init("xavier")
+            .activation("relu")
+            .list(
+                DenseLayer(n_out=16),
+                DenseLayer(n_out=16),
+                OutputLayer(n_out=3, loss="mcxent", activation="softmax"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_iris_trains_to_high_accuracy():
+    net = _iris_net()
+    it = IrisDataSetIterator(batch_size=32)
+    net.fit(it, epochs=60)
+    ev = net.evaluate(it)
+    assert ev.accuracy() > 0.93, ev.stats()
+
+
+def test_score_decreases():
+    net = _iris_net()
+    it = IrisDataSetIterator(batch_size=150)
+    ds = next(iter(it))
+    s0 = net.score(ds)
+    net.fit(it, epochs=30)
+    s1 = net.score(ds)
+    assert s1 < s0 / 2
+
+
+def test_output_shape_and_softmax():
+    net = _iris_net()
+    it = IrisDataSetIterator(batch_size=10)
+    ds = next(iter(it))
+    out = np.asarray(net.output(ds.features))
+    assert out.shape == (10, 3)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_serialization_roundtrip():
+    net = _iris_net()
+    it = IrisDataSetIterator(batch_size=50)
+    net.fit(it, epochs=3)
+    ds = next(iter(it))
+    out_before = np.asarray(net.output(ds.features))
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "model.zip")
+        save_model(net, path)
+        net2 = load_model(path)
+    out_after = np.asarray(net2.output(ds.features))
+    np.testing.assert_allclose(out_before, out_after, atol=1e-6)
+    assert net2.iteration == net.iteration
+    # training continues seamlessly after restore (updater state preserved)
+    net2.fit(it, epochs=1)
+
+
+def test_json_roundtrip():
+    net = _iris_net()
+    js = net.conf.to_json()
+    from deeplearning4j_tpu.nn.conf.builders import MultiLayerConfiguration
+    conf2 = MultiLayerConfiguration.from_json(js)
+    assert conf2.to_json() == js
+    net2 = MultiLayerNetwork(conf2).init()
+    assert net2.num_params() == net.num_params()
+
+
+def test_flat_param_view_roundtrip():
+    net = _iris_net()
+    flat = net.params_flat()
+    assert flat.size == net.num_params()
+    net2 = _iris_net()
+    net2.set_params_flat(flat)
+    np.testing.assert_allclose(net2.params_flat(), flat)
