@@ -11,16 +11,9 @@ Package map (mirrors the reference's module inventory, SURVEY.md section 2):
 
 - ``ops``       -- tensor op facade (activations, losses, conv, rng) over jax.numpy/lax
 - ``nn``        -- config system, layers, MultiLayerNetwork, ComputationGraph, updaters
-- ``optimize``  -- listeners, solvers, gradient accumulation
-- ``eval``      -- Evaluation / RegressionEvaluation / ROC
+- ``optimize``  -- listeners
+- ``evaluation`` -- Evaluation / RegressionEvaluation / ROC
 - ``datasets``  -- DataSet / iterators / built-in datasets
-- ``parallel``  -- mesh trainer (DP/TP/SP), ParallelWrapper/ParallelInference parity
-- ``models``    -- model zoo (LeNet ... ResNet50, VGG16)
-- ``nlp``       -- SequenceVectors / Word2Vec / ParagraphVectors / GloVe
-- ``graph_emb`` -- graph embeddings (DeepWalk, random walks)
-- ``modelimport`` -- Keras h5 import
-- ``ui``        -- stats listeners / storage / web UI
-- ``earlystopping`` -- early-stopping trainer
 - ``utils``     -- serialization (ModelSerializer-style zips), pytree helpers
 """
 

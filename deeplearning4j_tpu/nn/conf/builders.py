@@ -81,6 +81,18 @@ class MultiLayerConfiguration:
     # per-layer input types computed at build time (after preprocessor)
     layer_input_types: list = field(default_factory=list)
 
+    def __post_init__(self):
+        if (self.backprop_type == "tbptt"
+                and self.tbptt_back_length != self.tbptt_fwd_length):
+            # Reference semantics (MultiLayerNetwork.java:1364-1430) segment by the
+            # fwd length and truncate within-segment backprop at the back length;
+            # we support the fwd==back case (by far the common one) and reject the
+            # rest explicitly rather than silently ignoring back_length. Lives here
+            # (not only in the builder) so deserialized configs are covered too.
+            raise ValueError(
+                "tbptt_back_length != tbptt_fwd_length is not supported; "
+                f"got fwd={self.tbptt_fwd_length}, back={self.tbptt_back_length}")
+
     def to_json(self) -> str:
         return serde.to_json(self)
 

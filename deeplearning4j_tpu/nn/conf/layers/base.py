@@ -110,6 +110,7 @@ class BaseLayer(Layer):
     # Per-layer learning-rate override (reference: BaseLayer.learningRate /
     # biasLearningRate). None -> use the global updater learning rate.
     learning_rate: Optional[float] = None
+    bias_learning_rate: Optional[float] = None
 
     DEFAULT_ACTIVATION = "sigmoid"
 
@@ -138,14 +139,21 @@ class BaseLayer(Layer):
         return init_weight(rng, shape, fan_in, fan_out,
                            self.weight_init or "xavier", self.dist, dtype)
 
+    def bias_param_names(self) -> frozenset:
+        """Params that take l1_bias/l2_bias instead of l1/l2 (reference: the
+        ParamInitializer weight/bias split used by conf.getL2ByParam). Layers with
+        non-'b' bias names override this explicitly."""
+        return frozenset({"b"})
+
     def regularization(self, params: dict):
         reg = 0.0
         l1 = self.l1 or 0.0
         l2 = self.l2 or 0.0
         l1b = self.l1_bias or 0.0
         l2b = self.l2_bias or 0.0
+        biases = self.bias_param_names()
         for k, v in params.items():
-            if k.startswith("b") or k in ("beta", "mb", "lb", "db", "rb", "eb", "vb"):
+            if k in biases:
                 if l2b > 0:
                     reg = reg + 0.5 * l2b * jnp.sum(v * v)
                 if l1b > 0:

@@ -156,6 +156,9 @@ class AutoEncoder(FeedForwardLayer):
     def param_order(self):
         return ["W", "b", "vb"]
 
+    def bias_param_names(self):
+        return frozenset({"b", "vb"})
+
     def init_params(self, rng, dtype=jnp.float32):
         kw, _ = jax.random.split(rng)
         W = self._init_w(kw, (self.n_in, self.n_out), self.n_in, self.n_out, dtype)

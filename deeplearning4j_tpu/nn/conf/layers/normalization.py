@@ -51,6 +51,11 @@ class BatchNormalization(BaseLayer):
     def param_order(self):
         return [] if self.lock_gamma_beta else ["gamma", "beta"]
 
+    def regularization(self, params: dict):
+        # gamma/beta are never weight-decayed (reference:
+        # nn/layers/normalization/BatchNormalization.java:70-76 calcL1/calcL2 -> 0)
+        return 0.0
+
     def init_params(self, rng, dtype=jnp.float32):
         if self.lock_gamma_beta:
             return {}

@@ -171,6 +171,9 @@ class GravesBidirectionalLSTM(GravesLSTM):
         base = super().param_order()
         return [f"f_{k}" for k in base] + [f"b_{k}" for k in base]
 
+    def bias_param_names(self):
+        return frozenset({"f_b", "b_b"})
+
     def init_params(self, rng, dtype=jnp.float32):
         kf, kb = jax.random.split(rng)
         fwd = GravesLSTM.init_params(self, kf, dtype)
@@ -288,6 +291,11 @@ class LastTimeStep(BaseRecurrentLayer):
     def forward(self, params, state, x, *, mask=None, train=False, rng=None):
         if mask is None:
             return x[:, -1, :], state
-        idx = jnp.maximum(jnp.sum(mask, axis=1).astype(jnp.int32) - 1, 0)  # [B]
+        # Index of the LAST nonzero mask entry (correct for non-contiguous masks,
+        # reference: rnn/LastTimeStepVertex uses the last set bit, not the count).
+        T = x.shape[1]
+        t_idx = jnp.arange(T, dtype=jnp.int32)[None, :]
+        idx = jnp.max(jnp.where(mask > 0, t_idx, -1), axis=1)
+        idx = jnp.maximum(idx, 0)  # all-masked rows fall back to step 0
         out = jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0, :]
         return out, state

@@ -183,6 +183,12 @@ class VariationalAutoencoder(FeedForwardLayer):
         order += ["rW", "rb"]
         return order
 
+    def bias_param_names(self):
+        names = {f"eb{i}" for i in range(len(self.encoder_layer_sizes))}
+        names |= {f"db{i}" for i in range(len(self.decoder_layer_sizes))}
+        names |= {"mb", "lb", "rb"}
+        return frozenset(names)
+
     def init_params(self, rng, dtype=jnp.float32):
         params = {}
         keys = jax.random.split(rng, 3 + len(self.encoder_layer_sizes)

@@ -144,14 +144,33 @@ class MultiLayerNetwork:
         return data_loss + reg, (new_states, new_carry, last_in)
 
     # ---------------------------------------------------------- train step
+    def _lr_mult_tree(self):
+        """Per-leaf learning-rate multiplier pytree (structure == params), honoring
+        per-layer ``learning_rate`` and ``bias_learning_rate`` overrides (reference:
+        BaseMultiLayerUpdater per-param LR resolution). Returns None when every
+        multiplier is 1 (the common case — keeps the update one fused tree_map)."""
+        base_lr = getattr(self.conf.updater, "learning_rate", None)
+        if not base_lr:
+            return None
+        any_override = False
+        tree: dict = {}
+        for i, layer in enumerate(self.layers):
+            layer_lr = getattr(layer, "learning_rate", None)
+            bias_lr = getattr(layer, "bias_learning_rate", None)
+            biases = (layer.bias_param_names()
+                      if hasattr(layer, "bias_param_names") else frozenset())
+            leaf = {}
+            for name in self.params.get(str(i), {}):
+                lr = bias_lr if (name in biases and bias_lr is not None) else layer_lr
+                leaf[name] = (lr / base_lr) if lr is not None else 1.0
+                if lr is not None:
+                    any_override = True
+            tree[str(i)] = leaf
+        return tree if any_override else None
+
     def _make_step(self, with_carry: bool):
         updater = self.conf.updater
-        lr_mults = {}
-        base_lr = getattr(updater, "learning_rate", None)
-        for i, l in enumerate(self.layers):
-            lr = getattr(l, "learning_rate", None)
-            if lr is not None and base_lr:
-                lr_mults[str(i)] = lr / base_lr
+        lr_mults = self._lr_mult_tree()
 
         def step(params, opt_state, state, rng, iteration, x, y, input_mask,
                  label_mask, carry):
@@ -162,18 +181,9 @@ class MultiLayerNetwork:
 
             (loss, (new_states, new_carry, last_in)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
-            if lr_mults:
-                steps = {}
-                new_opt = {}
-                for key in params:
-                    sub_state = {slot: opt_state[slot][key] for slot in opt_state}
-                    s, ns = updater.step({key: grads[key]},
-                                         {slot: {key: sub_state[slot]} for slot in sub_state},
-                                         iteration, lr_mults.get(key, 1.0))
-                    steps[key] = s[key]
-                    for slot in ns:
-                        new_opt.setdefault(slot, {})[key] = ns[slot][key]
-                opt_state2 = new_opt
+            if lr_mults is not None:
+                steps, opt_state2 = updater.step(grads, opt_state, iteration,
+                                                 lr_mults)
             else:
                 steps, opt_state2 = updater.step(grads, opt_state, iteration)
             new_params = jax.tree_util.tree_map(lambda p, s: p - s, params, steps)
@@ -259,17 +269,20 @@ class MultiLayerNetwork:
             carry = jax.tree_util.tree_map(jax.lax.stop_gradient, carry)
 
     # ------------------------------------------------------------- inference
-    def output(self, x, train: bool = False):
-        """Final-layer activations (reference: MultiLayerNetwork.output :1717)."""
+    def output(self, x, train: bool = False, mask=None):
+        """Final-layer activations (reference: MultiLayerNetwork.output :1717,
+        incl. the mask-array overload — masks flow through the layers so e.g.
+        LastTimeStep / masked global pooling are correct for padded batches)."""
         x = jnp.asarray(x)
-        key = (x.shape, train)
+        mask = jnp.asarray(mask) if mask is not None else None
+        key = (x.shape, train, mask is not None)
         if key not in self._output_cache:
-            def fwd(params, state, xx):
-                out, _, _, _ = self._forward(params, state, xx, None, train=train,
+            def fwd(params, state, xx, mm):
+                out, _, _, _ = self._forward(params, state, xx, mm, train=train,
                                              rng=None)
                 return out
             self._output_cache[key] = jax.jit(fwd)
-        return self._output_cache[key](self.params, self.state, x)
+        return self._output_cache[key](self.params, self.state, x, mask)
 
     def score(self, ds=None, x=None, y=None) -> float:
         """Loss (incl. regularization) on a dataset (reference: computeGradientAndScore)."""
@@ -297,7 +310,7 @@ class MultiLayerNetwork:
         elif hasattr(data, "reset"):
             data.reset()
         for ds in data:
-            out = self.output(ds.features)
+            out = self.output(ds.features, mask=ds.features_mask)
             ev.eval(ds.labels, np.asarray(out), mask=ds.labels_mask)
         return ev
 

@@ -10,6 +10,12 @@ single-op UpdaterBlock application.
 Each updater computes the STEP to subtract: ``params_new = params - step``.
 Learning-rate schedules mirror nn/conf/LearningRatePolicy.java (Exponential, Inverse,
 Poly, Sigmoid, Step, Schedule map).
+
+Per-leaf learning rates (reference: BaseLayer.learningRate/biasLearningRate resolved
+per-parameter by BaseMultiLayerUpdater): ``lr_mult`` may be a scalar OR a pytree with
+the same structure as the gradients, giving each leaf its own multiplier. The
+effective learning rate enters the update formula itself (not a post-scale), so
+momentum-style updaters (Nesterovs) keep exact per-leaf semantics.
 """
 
 from __future__ import annotations
@@ -25,6 +31,10 @@ from deeplearning4j_tpu.utils.serde import register_serializable
 
 def _tree_zeros(params):
     return jax.tree_util.tree_map(jnp.zeros_like, params)
+
+
+def _tmap(f, *trees):
+    return jax.tree_util.tree_map(f, *trees)
 
 
 @register_serializable
@@ -79,6 +89,13 @@ class Updater:
     def lr(self, iteration):
         return self.lr_schedule(self.learning_rate, iteration)
 
+    def lr_tree(self, grads, iteration, lr_mult):
+        """Per-leaf effective learning rate: schedule(base_lr) * multiplier."""
+        lr = self.lr(iteration)
+        if isinstance(lr_mult, dict):
+            return _tmap(lambda m: lr * m, lr_mult)
+        return _tmap(lambda g: lr * lr_mult, grads)
+
     def step(self, grads, state, iteration, lr_mult=1.0):
         raise NotImplementedError
 
@@ -87,15 +104,15 @@ class Updater:
 @dataclass
 class Sgd(Updater):
     def step(self, grads, state, iteration, lr_mult=1.0):
-        lr = self.lr(iteration) * lr_mult
-        return jax.tree_util.tree_map(lambda g: lr * g, grads), state
+        lrs = self.lr_tree(grads, iteration, lr_mult)
+        return _tmap(lambda g, lr: lr * g, grads, lrs), state
 
 
 @register_serializable
 @dataclass
 class NoOp(Updater):
     def step(self, grads, state, iteration, lr_mult=1.0):
-        return jax.tree_util.tree_map(jnp.zeros_like, grads), state
+        return _tmap(jnp.zeros_like, grads), state
 
 
 @register_serializable
@@ -107,13 +124,12 @@ class Nesterovs(Updater):
         return {"v": _tree_zeros(params)}
 
     def step(self, grads, state, iteration, lr_mult=1.0):
-        lr = self.lr(iteration) * lr_mult
+        lrs = self.lr_tree(grads, iteration, lr_mult)
         mu = self.momentum
         v_old = state["v"]
-        v_new = jax.tree_util.tree_map(lambda v, g: mu * v - lr * g, v_old, grads)
+        v_new = _tmap(lambda v, g, lr: mu * v - lr * g, v_old, grads, lrs)
         # param += -mu*v_old + (1+mu)*v_new  (nd4j NesterovsUpdater form)
-        steps = jax.tree_util.tree_map(lambda vo, vn: mu * vo - (1.0 + mu) * vn,
-                                       v_old, v_new)
+        steps = _tmap(lambda vo, vn: mu * vo - (1.0 + mu) * vn, v_old, v_new)
         return steps, {"v": v_new}
 
 
@@ -129,15 +145,15 @@ class Adam(Updater):
         return {"m": _tree_zeros(params), "v": _tree_zeros(params)}
 
     def step(self, grads, state, iteration, lr_mult=1.0):
-        lr = self.lr(iteration) * lr_mult
+        lrs = self.lr_tree(grads, iteration, lr_mult)
         t = iteration + 1.0
         b1, b2 = self.beta1, self.beta2
-        m = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g, state["m"], grads)
-        v = jax.tree_util.tree_map(lambda v, g: b2 * v + (1 - b2) * g * g, state["v"],
-                                   grads)
-        alpha = lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
-        steps = jax.tree_util.tree_map(
-            lambda m, v: alpha * m / (jnp.sqrt(v) + self.epsilon), m, v)
+        m = _tmap(lambda m, g: b1 * m + (1 - b1) * g, state["m"], grads)
+        v = _tmap(lambda v, g: b2 * v + (1 - b2) * g * g, state["v"], grads)
+        bias_corr = jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        steps = _tmap(
+            lambda m, v, lr: lr * bias_corr * m / (jnp.sqrt(v) + self.epsilon), m, v,
+            lrs)
         return steps, {"m": m, "v": v}
 
 
@@ -153,14 +169,13 @@ class AdaMax(Updater):
         return {"m": _tree_zeros(params), "u": _tree_zeros(params)}
 
     def step(self, grads, state, iteration, lr_mult=1.0):
-        lr = self.lr(iteration) * lr_mult
+        lrs = self.lr_tree(grads, iteration, lr_mult)
         t = iteration + 1.0
         b1, b2 = self.beta1, self.beta2
-        m = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g, state["m"], grads)
-        u = jax.tree_util.tree_map(lambda u, g: jnp.maximum(b2 * u, jnp.abs(g)),
-                                   state["u"], grads)
-        alpha = lr / (1 - b1 ** t)
-        steps = jax.tree_util.tree_map(lambda m, u: alpha * m / (u + self.epsilon), m, u)
+        m = _tmap(lambda m, g: b1 * m + (1 - b1) * g, state["m"], grads)
+        u = _tmap(lambda u, g: jnp.maximum(b2 * u, jnp.abs(g)), state["u"], grads)
+        corr = 1.0 / (1 - b1 ** t)
+        steps = _tmap(lambda m, u, lr: lr * corr * m / (u + self.epsilon), m, u, lrs)
         return steps, {"m": m, "u": u}
 
 
@@ -176,16 +191,15 @@ class Nadam(Updater):
         return {"m": _tree_zeros(params), "v": _tree_zeros(params)}
 
     def step(self, grads, state, iteration, lr_mult=1.0):
-        lr = self.lr(iteration) * lr_mult
+        lrs = self.lr_tree(grads, iteration, lr_mult)
         t = iteration + 1.0
         b1, b2 = self.beta1, self.beta2
-        m = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g, state["m"], grads)
-        v = jax.tree_util.tree_map(lambda v, g: b2 * v + (1 - b2) * g * g, state["v"],
-                                   grads)
-        steps = jax.tree_util.tree_map(
-            lambda m, v, g: lr / (jnp.sqrt(v / (1 - b2 ** t)) + self.epsilon)
+        m = _tmap(lambda m, g: b1 * m + (1 - b1) * g, state["m"], grads)
+        v = _tmap(lambda v, g: b2 * v + (1 - b2) * g * g, state["v"], grads)
+        steps = _tmap(
+            lambda m, v, g, lr: lr / (jnp.sqrt(v / (1 - b2 ** t)) + self.epsilon)
             * (b1 * m / (1 - b1 ** (t + 1)) + (1 - b1) * g / (1 - b1 ** t)),
-            m, v, grads)
+            m, v, grads, lrs)
         return steps, {"m": m, "v": v}
 
 
@@ -198,10 +212,10 @@ class AdaGrad(Updater):
         return {"h": _tree_zeros(params)}
 
     def step(self, grads, state, iteration, lr_mult=1.0):
-        lr = self.lr(iteration) * lr_mult
-        h = jax.tree_util.tree_map(lambda h, g: h + g * g, state["h"], grads)
-        steps = jax.tree_util.tree_map(
-            lambda h, g: lr * g / (jnp.sqrt(h) + self.epsilon), h, grads)
+        lrs = self.lr_tree(grads, iteration, lr_mult)
+        h = _tmap(lambda h, g: h + g * g, state["h"], grads)
+        steps = _tmap(lambda h, g, lr: lr * g / (jnp.sqrt(h) + self.epsilon), h,
+                      grads, lrs)
         return steps, {"h": h}
 
 
@@ -215,12 +229,11 @@ class RmsProp(Updater):
         return {"h": _tree_zeros(params)}
 
     def step(self, grads, state, iteration, lr_mult=1.0):
-        lr = self.lr(iteration) * lr_mult
+        lrs = self.lr_tree(grads, iteration, lr_mult)
         d = self.rms_decay
-        h = jax.tree_util.tree_map(lambda h, g: d * h + (1 - d) * g * g, state["h"],
-                                   grads)
-        steps = jax.tree_util.tree_map(
-            lambda h, g: lr * g / (jnp.sqrt(h + self.epsilon)), h, grads)
+        h = _tmap(lambda h, g: d * h + (1 - d) * g * g, state["h"], grads)
+        steps = _tmap(lambda h, g, lr: lr * g / (jnp.sqrt(h + self.epsilon)), h,
+                      grads, lrs)
         return steps, {"h": h}
 
 
@@ -234,14 +247,13 @@ class AdaDelta(Updater):
         return {"eg": _tree_zeros(params), "ex": _tree_zeros(params)}
 
     def step(self, grads, state, iteration, lr_mult=1.0):
+        # AdaDelta has no learning rate (reference: nd4j AdaDeltaUpdater);
+        # lr_mult is intentionally ignored.
         rho, eps = self.rho, self.epsilon
-        eg = jax.tree_util.tree_map(lambda e, g: rho * e + (1 - rho) * g * g,
-                                    state["eg"], grads)
-        dx = jax.tree_util.tree_map(
-            lambda g, e, x: g * jnp.sqrt(x + eps) / jnp.sqrt(e + eps),
-            grads, eg, state["ex"])
-        ex = jax.tree_util.tree_map(lambda x, d: rho * x + (1 - rho) * d * d,
-                                    state["ex"], dx)
+        eg = _tmap(lambda e, g: rho * e + (1 - rho) * g * g, state["eg"], grads)
+        dx = _tmap(lambda g, e, x: g * jnp.sqrt(x + eps) / jnp.sqrt(e + eps),
+                   grads, eg, state["ex"])
+        ex = _tmap(lambda x, d: rho * x + (1 - rho) * d * d, state["ex"], dx)
         return dx, {"eg": eg, "ex": ex}
 
 

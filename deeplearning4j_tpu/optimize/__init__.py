@@ -1,4 +1,4 @@
-"""Optimization: training listeners, solvers, gradient accumulation."""
+"""Optimization: training listeners (reference: optimize/listeners/)."""
 
 from deeplearning4j_tpu.optimize.listeners import (
     TrainingListener,

@@ -1,0 +1,128 @@
+"""Regression tests for round-1 advisor/verdict findings.
+
+Covers: bias-vs-weight regularization classification (bidirectional LSTM, VAE),
+LastTimeStep with non-contiguous masks, per-layer/bias learning-rate plumbing,
+mask-aware output()/evaluate(), and tbptt back!=fwd rejection.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.layers.recurrent import (
+    GravesBidirectionalLSTM,
+    LastTimeStep,
+    LSTM,
+    RnnOutputLayer,
+)
+from deeplearning4j_tpu.nn.conf.layers.variational import VariationalAutoencoder
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Sgd
+
+
+def test_bidirectional_lstm_l2_covers_backward_weights():
+    """All weight params (f_* and b_* directions) must get l2; only f_b/b_b are biases."""
+    layer = GravesBidirectionalLSTM(n_in=3, n_out=4, l2=0.1, l1=0.0,
+                                    l2_bias=0.0, l1_bias=0.0)
+    layer.finalize(None)
+    params = {k: jnp.ones((2, 2)) if "W" in k else jnp.ones((4,))
+              for k in layer.param_order()}
+    reg = float(layer.regularization(params))
+    expected = 0.0
+    for k, v in params.items():
+        if k not in ("f_b", "b_b"):
+            expected += 0.5 * 0.1 * float(jnp.sum(v * v))
+    assert np.isclose(reg, expected), (reg, expected)
+
+
+def test_vae_bias_params_excluded_from_weight_decay():
+    vae = VariationalAutoencoder(n_in=4, n_out=2, encoder_layer_sizes=(3,),
+                                 decoder_layer_sizes=(3,), l2=0.5)
+    vae.finalize(None)
+    biases = vae.bias_param_names()
+    assert {"eb0", "db0", "mb", "lb", "rb"} <= set(biases)
+    params = vae.init_params(__import__("jax").random.PRNGKey(0))
+    reg = float(vae.regularization(params))
+    expected = sum(0.5 * 0.5 * float(jnp.sum(v * v))
+                   for k, v in params.items() if k not in biases)
+    assert np.isclose(reg, expected, rtol=1e-6)
+
+
+def test_last_time_step_non_contiguous_mask():
+    lts = LastTimeStep(n_in=2, n_out=2)
+    x = jnp.arange(2 * 5 * 2, dtype=jnp.float32).reshape(2, 5, 2)
+    # row 0: last active step is index 3 (interior zero at index 2)
+    # row 1: last active step is index 1
+    mask = jnp.array([[1, 1, 0, 1, 0], [1, 1, 0, 0, 0]], jnp.float32)
+    out, _ = lts.forward({}, {}, x, mask=mask)
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(x[0, 3]))
+    np.testing.assert_allclose(np.asarray(out[1]), np.asarray(x[1, 1]))
+
+
+def test_per_layer_and_bias_learning_rate():
+    """Layer 0 trains at 10x lr, its bias at 0x; layer 1 at base lr."""
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).updater(Sgd(learning_rate=0.1))
+            .list(DenseLayer(n_in=3, n_out=4, activation="identity",
+                             learning_rate=1.0, bias_learning_rate=0.0),
+                  OutputLayer(n_in=4, n_out=2, loss="mse",
+                              activation="identity"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    p0 = {k: np.asarray(v).copy() for k, v in net.params["0"].items()}
+    p1 = {k: np.asarray(v).copy() for k, v in net.params["1"].items()}
+    x = np.random.RandomState(0).randn(8, 3).astype(np.float32)
+    y = np.random.RandomState(1).randn(8, 2).astype(np.float32)
+    net.do_step(x, y)
+    # bias of layer 0 frozen by bias_learning_rate=0
+    np.testing.assert_allclose(np.asarray(net.params["0"]["b"]), p0["b"])
+    # weights of layer 0 moved 10x more than they would at base lr: just check moved
+    assert not np.allclose(np.asarray(net.params["0"]["W"]), p0["W"])
+    assert not np.allclose(np.asarray(net.params["1"]["W"]), p1["W"])
+    # ratio check: re-run with a copy at base lr and compare step magnitude
+    conf2 = (NeuralNetConfiguration.builder()
+             .seed(7).updater(Sgd(learning_rate=0.1))
+             .list(DenseLayer(n_in=3, n_out=4, activation="identity"),
+                   OutputLayer(n_in=4, n_out=2, loss="mse",
+                               activation="identity"))
+             .build())
+    net2 = MultiLayerNetwork(conf2).init()
+    net2.do_step(x, y)
+    step_fast = np.abs(np.asarray(net.params["0"]["W"]) - p0["W"])
+    step_base = np.abs(np.asarray(net2.params["0"]["W"]) - p0["W"])
+    np.testing.assert_allclose(step_fast, 10.0 * step_base, rtol=1e-4)
+
+
+def test_masked_output_and_evaluate():
+    """output(mask=...) must make LastTimeStep pick the right step for padded rows."""
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).updater(Sgd(learning_rate=0.05))
+            .list(LSTM(n_in=3, n_out=5),
+                  LastTimeStep(),
+                  OutputLayer(n_in=5, n_out=2, loss="mcxent",
+                              activation="softmax"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rs = np.random.RandomState(0)
+    x_full = rs.randn(4, 6, 3).astype(np.float32)
+    # rows padded after step 2: mask them out
+    mask = np.ones((4, 6), np.float32)
+    mask[2:, 3:] = 0.0
+    x_masked = x_full.copy()
+    x_masked[2:, 3:] = 999.0  # garbage in padded region
+    out_short = net.output(x_full[2:, :3])  # truth: only the 3 valid steps
+    out_masked = net.output(x_masked, mask=mask)
+    np.testing.assert_allclose(np.asarray(out_masked[2:]), np.asarray(out_short),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_tbptt_back_neq_fwd_rejected():
+    with pytest.raises(ValueError, match="tbptt_back_length"):
+        (NeuralNetConfiguration.builder()
+         .list(LSTM(n_in=2, n_out=3),
+               RnnOutputLayer(n_in=3, n_out=2, loss="mcxent"))
+         .backprop_type("tbptt", fwd_length=10, back_length=5)
+         .build())
