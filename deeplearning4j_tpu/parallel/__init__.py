@@ -1,0 +1,16 @@
+"""Parallelism: mesh data-parallel training, sharded inference, distributed eval.
+
+Replaces the reference's entire scale-out stack (deeplearning4j-scaleout/) with
+ICI-mesh collectives: ParallelWrapper's replica threads + averaging
+(parallelism/ParallelWrapper.java:53,148-305), the SHARED_GRADIENTS accumulator
+path (SymmetricTrainer.java:23-88), and Spark parameter averaging
+(spark/.../ParameterAveragingTrainingMaster.java:367-490) all become ONE jitted
+sharded step over a jax.sharding.Mesh — `shard_map` + `lax.pmean`. Multi-host
+(the Spark-cluster / Aeron-parameter-server role) is the same code over a mesh
+spanning hosts after `jax.distributed.initialize` (see distributed.py).
+"""
+
+from deeplearning4j_tpu.parallel.trainer import ParallelWrapper
+from deeplearning4j_tpu.parallel.inference import ParallelInference
+from deeplearning4j_tpu.parallel.evaluation import evaluate_on_mesh
+from deeplearning4j_tpu.parallel.mesh import data_mesh

@@ -1,0 +1,54 @@
+"""Multi-host distributed runtime.
+
+Replaces the reference's cluster transports — Spark broadcast/treeAggregate
+(ParameterAveragingTrainingMaster.java:367-490,867) and the Aeron UDP parameter
+server (ParameterServerTrainerContext.java:43, ParameterServerTrainer.java:48,68)
+— with the JAX distributed runtime: one `jax.distributed.initialize` per host,
+then every mesh in this package spans all hosts' devices and the SAME sharded
+step runs SPMD; XLA routes intra-pod reductions over ICI and cross-pod
+reductions over DCN. There is no separate parameter-server process: the
+"server" is the collective.
+
+Synchronous parity: Spark parameter averaging == ParallelWrapper AVERAGING mode
+on a global mesh (treeAggregate's sum/divide IS pmean). Async parameter-server
+semantics (Aeron push/pull) are intentionally not reproduced — on TPU meshes
+synchronous collectives are strictly faster than host-mediated async exchange;
+the `GradientsAccumulator` threshold-compression path (EncodingHandler.java:65)
+is provided for DCN-limited topologies in optimize/accumulation.py.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def initialize(coordinator_address=None, num_processes=None, process_id=None,
+               **kwargs) -> None:
+    """Join the multi-host runtime (call once per host before any mesh work).
+
+    With no arguments, defers to jax.distributed.initialize's environment
+    auto-detection (the standard call on TPU pod slices). Explicitly passing
+    ``num_processes=1`` is the single-process no-op. Mirrors the role of Spark
+    context + Aeron MediaDriver bootstrap in the reference, in one call.
+    """
+    if num_processes == 1:
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id, **kwargs)
+
+
+def global_device_count() -> int:
+    return jax.device_count()
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def is_coordinator() -> bool:
+    return jax.process_index() == 0

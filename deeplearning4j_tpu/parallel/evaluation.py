@@ -1,0 +1,45 @@
+"""Distributed (map-reduce) evaluation.
+
+Reference: SparkDl4jMultiLayer.evaluate (impl/multilayer/SparkDl4jMultiLayer
+.java:443-540) — executors each evaluate their partitions into an IEvaluation,
+then the results are reduced with IEvaluation.merge. Here the forward pass is
+sharded over the mesh (the "executors"), each batch becomes a partial
+evaluation on host, and the reduce is IEvaluation.merge — same algebra, ICI-fed.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+import numpy as np
+from jax.sharding import Mesh
+
+from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+
+def evaluate_on_mesh(net, iterator, mesh: Optional[Mesh] = None,
+                     evaluation=None):
+    """Evaluate ``net`` over all batches of ``iterator`` with mesh-sharded
+    forwards; one partial evaluation per batch ("partition"), merged at the
+    end. ``evaluation`` is a prototype instance (deep-copied per partial, so
+    constructor configuration like label names is preserved)."""
+    from deeplearning4j_tpu.evaluation.classification import Evaluation
+
+    if evaluation is None:
+        evaluation = Evaluation()
+    inf = ParallelInference(net, mesh=mesh)
+    result = None
+    if hasattr(iterator, "reset"):
+        iterator.reset()
+    for ds in iterator:
+        out = inf.output(ds.features, mask=ds.features_mask)
+        partial = copy.deepcopy(evaluation)
+        partial.eval(np.asarray(ds.labels), out,
+                     mask=None if ds.labels_mask is None
+                     else np.asarray(ds.labels_mask))
+        if result is None:
+            result = partial
+        else:
+            result.merge(partial)
+    return result if result is not None else evaluation

@@ -1,0 +1,67 @@
+"""ParallelInference: multi-device batched inference.
+
+Reference: parallelism/ParallelInference.java:33 — per-device worker threads,
+an observable queue, and optional request coalescing (BatchedInferenceObservable)
+to batch small requests before dispatch. TPU-native design: the forward pass is
+one jitted program whose batch axis is sharded over the mesh; "dispatching to N
+workers" is a sharding annotation, and request coalescing maps to host-side
+batching with padding to a multiple of the device count.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, data_mesh
+
+
+class ParallelInference:
+    def __init__(self, net, mesh: Optional[Mesh] = None,
+                 workers: Optional[int] = None):
+        self.net = net
+        self.mesh = mesh if mesh is not None else data_mesh(workers)
+        self.workers = self.mesh.devices.size
+        self._fwd_cache: dict = {}
+
+    def _get_fwd(self, shape, has_mask):
+        key = (shape, has_mask)
+        if key not in self._fwd_cache:
+            net = self.net
+            batch_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+            replicated = NamedSharding(self.mesh, P())
+
+            def fwd(params, state, x, mask):
+                out, _, _, _ = net._forward(params, state, x, mask, train=False,
+                                            rng=None)
+                return out
+
+            self._fwd_cache[key] = jax.jit(
+                fwd,
+                in_shardings=(replicated, replicated, batch_sharding,
+                              batch_sharding if has_mask else None),
+                out_shardings=batch_sharding)
+        return self._fwd_cache[key]
+
+    def output(self, x, mask=None):
+        """Sharded forward over the mesh; batch is padded to a multiple of the
+        worker count and the padding stripped from the result (the reference's
+        batched-observable coalescing, minus the threads)."""
+        x = np.asarray(x)
+        n = x.shape[0]
+        W = self.workers
+        pad = (-n) % W
+        if pad:
+            x = np.concatenate([x, np.repeat(x[-1:], pad, axis=0)], axis=0)
+            if mask is not None:
+                mask = np.concatenate(
+                    [np.asarray(mask), np.repeat(np.asarray(mask)[-1:], pad,
+                                                 axis=0)], axis=0)
+        fwd = self._get_fwd(x.shape, mask is not None)
+        out = fwd(self.net.params, self.net.state, jnp.asarray(x),
+                  jnp.asarray(mask) if mask is not None else None)
+        return np.asarray(out)[:n]

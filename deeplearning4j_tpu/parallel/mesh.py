@@ -1,0 +1,40 @@
+"""Device-mesh helpers.
+
+The reference pins model replicas to devices round-robin
+(ParallelWrapper.java:148-245, trainer/DefaultTrainer.java device affinity);
+here device placement is a jax.sharding.Mesh and XLA lays out the collectives
+over ICI. One axis name is used throughout the data-parallel stack: ``data``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def data_mesh(num_devices: Optional[int] = None, devices=None) -> Mesh:
+    """1-D data-parallel mesh over the first ``num_devices`` devices (default all)."""
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"Requested {num_devices} devices but only {len(devices)} present")
+        devices = devices[:num_devices]
+    return Mesh(np.array(devices), (DATA_AXIS,))
+
+
+def data_model_mesh(data: int, model: int, devices=None) -> Mesh:
+    """2-D mesh: ``data`` x ``model`` axes (DP x TP)."""
+    if devices is None:
+        devices = jax.devices()
+    n = data * model
+    if n > len(devices):
+        raise ValueError(f"Mesh {data}x{model} needs {n} devices, have {len(devices)}")
+    return Mesh(np.array(devices[:n]).reshape(data, model), (DATA_AXIS, MODEL_AXIS))

@@ -1,0 +1,169 @@
+"""Mesh data-parallel tests on the virtual 8-device CPU mesh.
+
+The core invariant is ported from the reference's
+TestCompareParameterAveragingSparkVsSingleMachine.java: distributed training
+with averaging_frequency=1 must equal single-machine training on the
+concatenated batch, to float tolerance. Plus: SHARED_GRADIENTS step parity,
+averaging_frequency>1 local-SGD rounds, sharded inference parity, and
+map-reduce Evaluation.merge.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.layers.recurrent import LSTM, RnnOutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Adam, Sgd
+from deeplearning4j_tpu.parallel import (
+    ParallelInference,
+    ParallelWrapper,
+    data_mesh,
+    evaluate_on_mesh,
+)
+
+
+def _mlp_conf(updater, seed=42):
+    return (NeuralNetConfiguration.builder()
+            .seed(seed).updater(updater)
+            .list(DenseLayer(n_in=6, n_out=16, activation="tanh"),
+                  OutputLayer(n_in=16, n_out=3, activation="softmax",
+                              loss="mcxent"))
+            .build())
+
+
+def _make_data(n, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, 6).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, n)]
+    return x, y
+
+
+def test_eight_devices_available():
+    assert len(jax.devices()) == 8
+
+
+def test_averaging_freq1_equals_single_device():
+    """8-device DP with per-device batch 4 == single device with batch 32."""
+    W, B, steps = 8, 4, 5
+    x, y = _make_data(W * B * steps)
+
+    single = MultiLayerNetwork(_mlp_conf(Sgd(learning_rate=0.1))).init()
+    for s in range(steps):
+        sl = slice(s * W * B, (s + 1) * W * B)
+        single.do_step(x[sl], y[sl])
+
+    dist = MultiLayerNetwork(_mlp_conf(Sgd(learning_rate=0.1))).init()
+    batches = [DataSet(x[i * B:(i + 1) * B], y[i * B:(i + 1) * B])
+               for i in range(W * steps)]
+    pw = ParallelWrapper(dist, workers=8, averaging_frequency=1)
+    pw.fit(ListDataSetIterator(batches, batch_size=B))
+
+    for k in single.params:
+        for name in single.params[k]:
+            np.testing.assert_allclose(
+                np.asarray(dist.params[k][name]),
+                np.asarray(single.params[k][name]), rtol=1e-5, atol=1e-6,
+                err_msg=f"param {k}/{name}")
+
+
+def test_shared_gradients_equals_single_device_adam():
+    """SHARED_GRADIENTS keeps replicas exactly in sync even with Adam state."""
+    W, B, steps = 8, 4, 4
+    x, y = _make_data(W * B * steps, seed=3)
+
+    single = MultiLayerNetwork(_mlp_conf(Adam(learning_rate=1e-2))).init()
+    for s in range(steps):
+        sl = slice(s * W * B, (s + 1) * W * B)
+        single.do_step(x[sl], y[sl])
+
+    dist = MultiLayerNetwork(_mlp_conf(Adam(learning_rate=1e-2))).init()
+    batches = [DataSet(x[i * B:(i + 1) * B], y[i * B:(i + 1) * B])
+               for i in range(W * steps)]
+    pw = ParallelWrapper(dist, workers=8, averaging_frequency=1,
+                         mode="shared_gradients")
+    pw.fit(ListDataSetIterator(batches, batch_size=B))
+
+    for k in single.params:
+        for name in single.params[k]:
+            np.testing.assert_allclose(
+                np.asarray(dist.params[k][name]),
+                np.asarray(single.params[k][name]), rtol=1e-4, atol=1e-5,
+                err_msg=f"param {k}/{name}")
+
+
+def test_averaging_frequency_local_sgd():
+    """freq=3: 8 workers each take 3 local steps then average; loss decreases
+    and the final params are finite and shared."""
+    W, B, F, rounds = 8, 4, 3, 4
+    x, y = _make_data(W * B * F * rounds, seed=5)
+    net = MultiLayerNetwork(_mlp_conf(Sgd(learning_rate=0.1))).init()
+    batches = [DataSet(x[i * B:(i + 1) * B], y[i * B:(i + 1) * B])
+               for i in range(W * F * rounds)]
+    pw = ParallelWrapper(net, workers=8, averaging_frequency=F)
+    s0 = net.score(x=x, y=y)
+    pw.fit(ListDataSetIterator(batches, batch_size=B), epochs=3)
+    s1 = net.score(x=x, y=y)
+    assert np.isfinite(s1) and s1 < s0
+    assert net.iteration == 3 * rounds * F
+
+
+def test_averaging_with_updater_state():
+    """freq>1 with a momentum updater: updater state averaged without error."""
+    W, B, F = 4, 4, 2
+    x, y = _make_data(W * B * F * 3, seed=7)
+    net = MultiLayerNetwork(_mlp_conf(Adam(learning_rate=1e-2))).init()
+    batches = [DataSet(x[i * B:(i + 1) * B], y[i * B:(i + 1) * B])
+               for i in range(W * F * 3)]
+    mesh = data_mesh(4)
+    pw = ParallelWrapper(net, mesh=mesh, averaging_frequency=F,
+                         average_updaters=True)
+    pw.fit(ListDataSetIterator(batches, batch_size=B))
+    flat = net.params_flat()
+    assert np.all(np.isfinite(flat))
+    # Adam slots must mirror param structure after averaging
+    assert set(net.updater_state.keys()) == {"m", "v"}
+
+
+def test_parallel_inference_matches_output():
+    net = MultiLayerNetwork(_mlp_conf(Sgd(learning_rate=0.1))).init()
+    x, y = _make_data(21, seed=11)  # deliberately not divisible by 8
+    inf = ParallelInference(net, workers=8)
+    out_par = inf.output(x)
+    out_seq = np.asarray(net.output(x))
+    np.testing.assert_allclose(out_par, out_seq, rtol=1e-5, atol=1e-6)
+
+
+def test_parallel_inference_rnn_with_mask():
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater(Sgd(learning_rate=0.1))
+            .list(LSTM(n_in=4, n_out=6),
+                  RnnOutputLayer(n_in=6, n_out=2, activation="softmax",
+                                 loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rs = np.random.RandomState(2)
+    x = rs.randn(10, 5, 4).astype(np.float32)
+    mask = (rs.rand(10, 5) > 0.3).astype(np.float32)
+    mask[:, 0] = 1.0
+    inf = ParallelInference(net, workers=8)
+    np.testing.assert_allclose(inf.output(x, mask=mask),
+                               np.asarray(net.output(x, mask=mask)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_distributed_evaluation_merge():
+    """Mesh evaluation (per-shard evals + merge) == sequential evaluation."""
+    net = MultiLayerNetwork(_mlp_conf(Sgd(learning_rate=0.1))).init()
+    x, y = _make_data(64, seed=13)
+    batches = [DataSet(x[i * 16:(i + 1) * 16], y[i * 16:(i + 1) * 16])
+               for i in range(4)]
+    net.fit(ListDataSetIterator(batches, batch_size=16), epochs=2)
+    ev_seq = net.evaluate(ListDataSetIterator(batches, batch_size=16))
+    ev_par = evaluate_on_mesh(net, ListDataSetIterator(batches, batch_size=16))
+    assert ev_par.accuracy() == pytest.approx(ev_seq.accuracy())
+    assert ev_par.f1() == pytest.approx(ev_seq.f1())
