@@ -28,12 +28,19 @@ def check_gradients(net, x, y, input_mask=None, label_mask=None, *, eps: float =
     """Returns True if all checked parameter gradients pass."""
     import jax.numpy as jnp
 
-    x = jnp.asarray(x)
-    y = jnp.asarray(y)
-    im = None if input_mask is None else jnp.asarray(input_mask)
-    lm = None if label_mask is None else jnp.asarray(label_mask)
+    def _to_jnp(a):
+        if a is None:
+            return None
+        if isinstance(a, (list, tuple)):  # multi-input/-output graphs
+            return [None if e is None else jnp.asarray(e) for e in a]
+        return jnp.asarray(a)
+
+    x = _to_jnp(x)
+    y = _to_jnp(y)
+    im = _to_jnp(input_mask)
+    lm = _to_jnp(label_mask)
     params0 = net.params
-    layers = net.layers
+    layers = getattr(net, "layers", None)
 
     def loss_of(params):
         loss, _ = net._loss(params, net.state, x, y, im, lm, train=train, rng=None)
@@ -41,8 +48,22 @@ def check_gradients(net, x, y, input_mask=None, label_mask=None, *, eps: float =
 
     loss_jit = jax.jit(loss_of)
     analytic_tree = jax.grad(loss_of)(params0)
-    analytic = flatten_params(analytic_tree, layers).astype(np.float64)
-    flat0 = flatten_params(params0, layers).astype(np.float64)
+    if isinstance(layers, list):
+        analytic = flatten_params(analytic_tree, layers).astype(np.float64)
+        flat0 = flatten_params(params0, layers).astype(np.float64)
+
+        def unflatten(flat):
+            return unflatten_params(flat, params0, layers)
+    else:
+        # graph nets: order-agnostic flat view via ravel_pytree
+        from jax.flatten_util import ravel_pytree
+
+        flat0_j, unravel = ravel_pytree(params0)
+        flat0 = np.asarray(flat0_j).astype(np.float64)
+        analytic = np.asarray(ravel_pytree(analytic_tree)[0]).astype(np.float64)
+
+        def unflatten(flat):
+            return unravel(jnp.asarray(flat, dtype=flat0_j.dtype))
 
     n = flat0.size
     if subset is not None and subset < n:
@@ -51,7 +72,7 @@ def check_gradients(net, x, y, input_mask=None, label_mask=None, *, eps: float =
         idxs = np.arange(n)
 
     def loss_flat(flat):
-        return float(loss_jit(unflatten_params(flat, params0, layers)))
+        return float(loss_jit(unflatten(flat)))
 
     n_fail = 0
     max_err = 0.0

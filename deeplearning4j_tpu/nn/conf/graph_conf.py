@@ -1,0 +1,595 @@
+"""ComputationGraph configuration: vertices + GraphBuilder.
+
+Reference: nn/conf/ComputationGraphConfiguration.java:438 (GraphBuilder) and the
+vertex conf/impl pairs under nn/conf/graph/ + nn/graph/vertex/impl/ (MergeVertex,
+ElementWiseVertex, StackVertex, UnstackVertex, SubsetVertex, ScaleVertex,
+ShiftVertex, L2Vertex, L2NormalizeVertex, ReshapeVertex, PoolHelperVertex,
+PreprocessorVertex, rnn/LastTimeStepVertex, rnn/DuplicateToTimeSeriesVertex).
+
+TPU-native design: a vertex is a *pure function* over its input arrays — the
+reference's per-vertex doForward/doBackward pairs collapse to forward-only
+functions differentiated by jax.grad, and the whole DAG (in topological order)
+traces into ONE XLA program. The graph structure itself lives in the config
+(names, input lists, topo order computed at build with Kahn + cycle detection,
+mirroring ComputationGraph.java:1084-1186) so the runtime never re-derives it.
+
+Layouts are TPU-first: NHWC images, [B, T, F] sequences — so "the feature axis"
+is always the last axis, which makes Merge/Subset single-axis ops that XLA fuses
+into neighbouring work.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.builders import (
+    NeuralNetConfiguration,
+    default_preprocessor,
+)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import Layer
+from deeplearning4j_tpu.nn.conf.preprocessors import InputPreProcessor
+from deeplearning4j_tpu.nn.updater import Sgd, Updater
+from deeplearning4j_tpu.utils import serde
+from deeplearning4j_tpu.utils.serde import register_serializable
+
+
+# --------------------------------------------------------------------------- #
+# Vertex contract
+# --------------------------------------------------------------------------- #
+@dataclass
+class GraphVertex:
+    """Base vertex: a pure function of its input arrays.
+
+    Contract (multi-input analogue of the Layer contract in layers/base.py):
+
+    - ``init_params(rng, dtype) -> dict``; ``param_order() -> [names]``
+    - ``forward(params, state, inputs, *, masks, ctx, train, rng)``
+      -> ``(out, new_state)`` where ``inputs``/``masks`` are lists parallel to
+      the vertex's declared inputs and ``ctx`` carries network-input arrays and
+      masks for vertices that need them (LastTimeStepVertex mask lookup,
+      DuplicateToTimeSeriesVertex length lookup).
+    - ``output_type(input_types) -> InputType`` for shape inference.
+    """
+
+    name: Optional[str] = None
+
+    def finalize(self, g=None) -> None:
+        pass
+
+    def param_order(self) -> list:
+        return []
+
+    def init_params(self, rng, dtype=jnp.float32) -> dict:
+        return {}
+
+    def init_state(self) -> dict:
+        return {}
+
+    def has_params(self) -> bool:
+        return bool(self.param_order())
+
+    def regularization(self, params):
+        return 0.0
+
+    def output_type(self, input_types: list) -> InputType:
+        return input_types[0]
+
+    def forward(self, params, state, inputs, *, masks=None, ctx=None,
+                train=False, rng=None):
+        raise NotImplementedError
+
+    def feed_forward_mask(self, masks):
+        """Combine/propagate input time-masks (default: first non-None)."""
+        if not masks:
+            return None
+        for m in masks:
+            if m is not None:
+                return m
+        return None
+
+
+@register_serializable
+@dataclass
+class LayerVertex(GraphVertex):
+    """A Layer inside the graph, with an optional InputPreProcessor
+    (reference: nn/graph/vertex/impl/LayerVertex.java)."""
+
+    layer: Optional[Layer] = None
+    preprocessor: Optional[InputPreProcessor] = None
+
+    def finalize(self, g=None) -> None:
+        self.layer.finalize(g)
+
+    def param_order(self):
+        return self.layer.param_order()
+
+    def init_params(self, rng, dtype=jnp.float32):
+        return self.layer.init_params(rng, dtype)
+
+    def init_state(self):
+        return self.layer.init_state()
+
+    def regularization(self, params):
+        return self.layer.regularization(params)
+
+    def output_type(self, input_types):
+        it = input_types[0]
+        if self.preprocessor is not None:
+            it = self.preprocessor.output_type(it)
+        return self.layer.output_type(it)
+
+    def forward(self, params, state, inputs, *, masks=None, ctx=None,
+                train=False, rng=None):
+        x = inputs[0]
+        mask = masks[0] if masks else None
+        if self.preprocessor is not None:
+            x = self.preprocessor.forward(x)
+            mask = self.preprocessor.feed_forward_mask(mask)
+        return self.layer.forward(params, state, x, mask=mask, train=train,
+                                  rng=rng)
+
+    def feed_forward_mask(self, masks):
+        mask = masks[0] if masks else None
+        if self.preprocessor is not None:
+            mask = self.preprocessor.feed_forward_mask(mask)
+        return self.layer.feed_forward_mask(mask)
+
+
+@register_serializable
+@dataclass
+class MergeVertex(GraphVertex):
+    """Concatenate along the feature axis — last axis in our NHWC/[B,T,F]
+    layouts (reference: nn/graph/vertex/impl/MergeVertex.java, which merges
+    along dim 1 in NCHW; same logical op)."""
+
+    def output_type(self, input_types):
+        first = input_types[0]
+        if first.kind == "convolutional":
+            return InputType.convolutional(
+                first.height, first.width,
+                sum(t.channels for t in input_types))
+        if first.kind == "recurrent":
+            return InputType.recurrent(sum(t.size for t in input_types),
+                                       first.timeseries_length)
+        return InputType.feed_forward(sum(t.flat_size() for t in input_types))
+
+    def forward(self, params, state, inputs, *, masks=None, ctx=None,
+                train=False, rng=None):
+        return jnp.concatenate(inputs, axis=-1), state
+
+
+@register_serializable
+@dataclass
+class ElementWiseVertex(GraphVertex):
+    """Pointwise combine: Add | Subtract | Product | Average | Max
+    (reference: nn/conf/graph/ElementWiseVertex.java Op enum)."""
+
+    op: str = "add"
+
+    def forward(self, params, state, inputs, *, masks=None, ctx=None,
+                train=False, rng=None):
+        op = self.op.lower()
+        out = inputs[0]
+        if op == "add":
+            for x in inputs[1:]:
+                out = out + x
+        elif op == "subtract":
+            if len(inputs) != 2:
+                raise ValueError("subtract requires exactly 2 inputs")
+            out = inputs[0] - inputs[1]
+        elif op == "product":
+            for x in inputs[1:]:
+                out = out * x
+        elif op == "average":
+            out = sum(inputs) / float(len(inputs))
+        elif op == "max":
+            for x in inputs[1:]:
+                out = jnp.maximum(out, x)
+        else:
+            raise ValueError(f"Unknown ElementWiseVertex op '{self.op}'")
+        return out, state
+
+
+@register_serializable
+@dataclass
+class SubsetVertex(GraphVertex):
+    """Feature-axis slice [from, to] inclusive (reference:
+    nn/graph/vertex/impl/SubsetVertex.java)."""
+
+    from_index: int = 0
+    to_index: int = 0
+
+    def output_type(self, input_types):
+        n = self.to_index - self.from_index + 1
+        it = input_types[0]
+        if it.kind == "recurrent":
+            return InputType.recurrent(n, it.timeseries_length)
+        if it.kind == "convolutional":
+            return InputType.convolutional(it.height, it.width, n)
+        return InputType.feed_forward(n)
+
+    def forward(self, params, state, inputs, *, masks=None, ctx=None,
+                train=False, rng=None):
+        return inputs[0][..., self.from_index:self.to_index + 1], state
+
+
+@register_serializable
+@dataclass
+class StackVertex(GraphVertex):
+    """Concatenate along the batch (0) axis (reference:
+    nn/graph/vertex/impl/StackVertex.java)."""
+
+    def forward(self, params, state, inputs, *, masks=None, ctx=None,
+                train=False, rng=None):
+        return jnp.concatenate(inputs, axis=0), state
+
+
+@register_serializable
+@dataclass
+class UnstackVertex(GraphVertex):
+    """Select slice ``from_index`` of ``stack_size`` equal batch chunks
+    (reference: nn/graph/vertex/impl/UnstackVertex.java)."""
+
+    from_index: int = 0
+    stack_size: int = 1
+
+    def forward(self, params, state, inputs, *, masks=None, ctx=None,
+                train=False, rng=None):
+        x = inputs[0]
+        step = x.shape[0] // self.stack_size
+        return x[self.from_index * step:(self.from_index + 1) * step], state
+
+
+@register_serializable
+@dataclass
+class ScaleVertex(GraphVertex):
+    """out = scale * x (reference: nn/conf/graph/ScaleVertex.java)."""
+
+    scale: float = 1.0
+
+    def forward(self, params, state, inputs, *, masks=None, ctx=None,
+                train=False, rng=None):
+        return inputs[0] * self.scale, state
+
+
+@register_serializable
+@dataclass
+class ShiftVertex(GraphVertex):
+    """out = x + shift (reference: nn/conf/graph/ShiftVertex.java)."""
+
+    shift: float = 0.0
+
+    def forward(self, params, state, inputs, *, masks=None, ctx=None,
+                train=False, rng=None):
+        return inputs[0] + self.shift, state
+
+
+@register_serializable
+@dataclass
+class L2Vertex(GraphVertex):
+    """Pairwise L2 distance between two inputs -> [B, 1] (reference:
+    nn/graph/vertex/impl/L2Vertex.java; eps guards the sqrt gradient at 0)."""
+
+    eps: float = 1e-8
+
+    def output_type(self, input_types):
+        return InputType.feed_forward(1)
+
+    def forward(self, params, state, inputs, *, masks=None, ctx=None,
+                train=False, rng=None):
+        a, b = inputs[0], inputs[1]
+        d = (a - b).reshape(a.shape[0], -1)
+        return jnp.sqrt(jnp.sum(d * d, axis=1, keepdims=True) + self.eps), state
+
+
+@register_serializable
+@dataclass
+class L2NormalizeVertex(GraphVertex):
+    """x / ||x||_2 over all non-batch axes (reference:
+    nn/graph/vertex/impl/L2NormalizeVertex.java)."""
+
+    eps: float = 1e-8
+
+    def forward(self, params, state, inputs, *, masks=None, ctx=None,
+                train=False, rng=None):
+        x = inputs[0]
+        flat = x.reshape(x.shape[0], -1)
+        norm = jnp.sqrt(jnp.sum(flat * flat, axis=1) + self.eps)
+        return x / norm.reshape((-1,) + (1,) * (x.ndim - 1)), state
+
+
+@register_serializable
+@dataclass
+class PreprocessorVertex(GraphVertex):
+    """Standalone InputPreProcessor as a vertex (reference:
+    nn/graph/vertex/impl/PreprocessorVertex.java)."""
+
+    preprocessor: Optional[InputPreProcessor] = None
+
+    def output_type(self, input_types):
+        return self.preprocessor.output_type(input_types[0])
+
+    def forward(self, params, state, inputs, *, masks=None, ctx=None,
+                train=False, rng=None):
+        return self.preprocessor.forward(inputs[0]), state
+
+    def feed_forward_mask(self, masks):
+        return self.preprocessor.feed_forward_mask(masks[0] if masks else None)
+
+
+@register_serializable
+@dataclass
+class ReshapeVertex(GraphVertex):
+    """Reshape non-batch dims (reference: nn/graph/vertex/impl/ReshapeVertex.java)."""
+
+    shape: tuple = ()
+
+    def forward(self, params, state, inputs, *, masks=None, ctx=None,
+                train=False, rng=None):
+        x = inputs[0]
+        return x.reshape((x.shape[0],) + tuple(self.shape)), state
+
+
+@register_serializable
+@dataclass
+class PoolHelperVertex(GraphVertex):
+    """Strip the first row+column of CNN activations — compatibility shim for
+    imported GoogLeNet-style models (reference:
+    nn/graph/vertex/impl/PoolHelperVertex.java). NHWC here."""
+
+    def output_type(self, input_types):
+        it = input_types[0]
+        return InputType.convolutional(it.height - 1, it.width - 1, it.channels)
+
+    def forward(self, params, state, inputs, *, masks=None, ctx=None,
+                train=False, rng=None):
+        return inputs[0][:, 1:, 1:, :], state
+
+
+@register_serializable
+@dataclass
+class LastTimeStepVertex(GraphVertex):
+    """[B,T,F] -> [B,F] at the last *active* timestep per the mask of the named
+    network input (reference: nn/graph/vertex/impl/rnn/LastTimeStepVertex.java)."""
+
+    mask_input: Optional[str] = None
+
+    def output_type(self, input_types):
+        return InputType.feed_forward(input_types[0].size)
+
+    def forward(self, params, state, inputs, *, masks=None, ctx=None,
+                train=False, rng=None):
+        x = inputs[0]
+        mask = None
+        if self.mask_input is not None and ctx is not None:
+            mask = ctx.get("input_masks", {}).get(self.mask_input)
+        if mask is None and masks:
+            mask = masks[0]
+        if mask is None:
+            return x[:, -1, :], state
+        T = x.shape[1]
+        m = mask.astype(jnp.float32)
+        # index of last nonzero mask entry (handles non-contiguous masks)
+        idx = jnp.argmax(jnp.arange(1, T + 1, dtype=jnp.float32)[None, :] * m,
+                         axis=1).astype(jnp.int32)
+        return jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0, :], state
+
+    def feed_forward_mask(self, masks):
+        return None
+
+
+@register_serializable
+@dataclass
+class DuplicateToTimeSeriesVertex(GraphVertex):
+    """[B,F] -> [B,T,F], T taken from the named network input (reference:
+    nn/graph/vertex/impl/rnn/DuplicateToTimeSeriesVertex.java)."""
+
+    input_name: Optional[str] = None
+
+    def output_type(self, input_types):
+        return InputType.recurrent(input_types[0].flat_size())
+
+    def forward(self, params, state, inputs, *, masks=None, ctx=None,
+                train=False, rng=None):
+        x = inputs[0]
+        ref = ctx["input_arrays"][self.input_name]
+        T = ref.shape[1]
+        out = jnp.broadcast_to(x[:, None, :], (x.shape[0], T, x.shape[1]))
+        if self.input_name is not None and ctx is not None:
+            m = ctx.get("input_masks", {}).get(self.input_name)
+            if m is not None:
+                return out, state
+        return out, state
+
+    def feed_forward_mask(self, masks):
+        return None
+
+
+# --------------------------------------------------------------------------- #
+# Configuration + builder
+# --------------------------------------------------------------------------- #
+@register_serializable
+@dataclass
+class ComputationGraphConfiguration:
+    """Finalised DAG config (reference: nn/conf/ComputationGraphConfiguration.java).
+
+    ``topo_order`` is computed once at build (Kahn + cycle detection, parity with
+    ComputationGraph.java:1084-1186) and serialized, so restores skip re-sorting.
+    """
+
+    network_inputs: list = field(default_factory=list)
+    network_outputs: list = field(default_factory=list)
+    vertices: dict = field(default_factory=dict)        # {name: GraphVertex}
+    vertex_inputs: dict = field(default_factory=dict)   # {name: [input names]}
+    topo_order: list = field(default_factory=list)
+    input_types: Optional[list] = None
+    seed: int = 0
+    updater: Updater = field(default_factory=lambda: Sgd(learning_rate=0.1))
+    backprop_type: str = "standard"
+    tbptt_fwd_length: int = 20
+    tbptt_back_length: int = 20
+    pretrain: bool = False
+    dtype: str = "float32"
+
+    def to_json(self) -> str:
+        return serde.to_json(self)
+
+    @staticmethod
+    def from_json(s: str) -> "ComputationGraphConfiguration":
+        return serde.from_json(s)
+
+    def n_layers(self) -> int:
+        return sum(1 for v in self.vertices.values()
+                   if isinstance(v, LayerVertex))
+
+
+def topological_sort(vertex_inputs: dict, network_inputs: list) -> list:
+    """Kahn's algorithm over vertex names; raises on cycles or dangling inputs
+    (reference: ComputationGraph.java:1084-1186)."""
+    names = list(vertex_inputs.keys())
+    known = set(names) | set(network_inputs)
+    for name, ins in vertex_inputs.items():
+        for i in ins:
+            if i not in known:
+                raise ValueError(f"Vertex '{name}' input '{i}' is not a network "
+                                 "input or another vertex")
+    indeg = {n: sum(1 for i in vertex_inputs[n] if i not in network_inputs)
+             for n in names}
+    children: dict = {n: [] for n in names}
+    for name, ins in vertex_inputs.items():
+        for i in ins:
+            if i in children:
+                children[i].append(name)
+    queue = [n for n in names if indeg[n] == 0]
+    order = []
+    while queue:
+        n = queue.pop(0)
+        order.append(n)
+        for c in children[n]:
+            indeg[c] -= 1
+            if indeg[c] == 0:
+                queue.append(c)
+    if len(order) != len(names):
+        cyc = [n for n in names if n not in order]
+        raise ValueError(f"Cycle detected in graph at vertices {cyc}")
+    return order
+
+
+class GraphBuilder:
+    """Fluent DAG builder (reference: ComputationGraphConfiguration.GraphBuilder,
+    nn/conf/ComputationGraphConfiguration.java:438)."""
+
+    def __init__(self, global_conf: NeuralNetConfiguration):
+        self._g = global_conf
+        self._inputs: list = []
+        self._outputs: list = []
+        self._vertices: dict = {}
+        self._vertex_inputs: dict = {}
+        self._input_types: Optional[list] = None
+        self._backprop_type = "standard"
+        self._tbptt_fwd = 20
+        self._tbptt_back = 20
+        self._pretrain = False
+
+    def add_inputs(self, *names) -> "GraphBuilder":
+        self._inputs.extend(names)
+        return self
+
+    def set_input_types(self, *types) -> "GraphBuilder":
+        self._input_types = list(types)
+        return self
+
+    def add_layer(self, name: str, layer: Layer, *inputs,
+                  preprocessor: Optional[InputPreProcessor] = None
+                  ) -> "GraphBuilder":
+        return self.add_vertex(
+            name, LayerVertex(layer=layer, preprocessor=preprocessor), *inputs)
+
+    def add_vertex(self, name: str, vertex: GraphVertex, *inputs
+                   ) -> "GraphBuilder":
+        if name in self._vertices or name in self._inputs:
+            raise ValueError(f"Duplicate vertex/input name '{name}'")
+        if not inputs:
+            raise ValueError(f"Vertex '{name}' needs at least one input")
+        vertex.name = name
+        self._vertices[name] = vertex
+        self._vertex_inputs[name] = list(inputs)
+        return self
+
+    def set_outputs(self, *names) -> "GraphBuilder":
+        self._outputs = list(names)
+        return self
+
+    def backprop_type(self, t: str, fwd_length: int = 20, back_length: int = 20
+                      ) -> "GraphBuilder":
+        self._backprop_type = t
+        self._tbptt_fwd = fwd_length
+        self._tbptt_back = back_length
+        return self
+
+    def t_bptt_lengths(self, fwd: int, back: Optional[int] = None
+                       ) -> "GraphBuilder":
+        return self.backprop_type("tbptt", fwd,
+                                  back if back is not None else fwd)
+
+    def pretrain(self, flag: bool) -> "GraphBuilder":
+        self._pretrain = flag
+        return self
+
+    def build(self) -> ComputationGraphConfiguration:
+        if not self._inputs:
+            raise ValueError("Graph has no inputs (call add_inputs)")
+        if not self._outputs:
+            raise ValueError("Graph has no outputs (call set_outputs)")
+        for o in self._outputs:
+            if o not in self._vertices:
+                raise ValueError(f"Output '{o}' is not a vertex")
+        vertices = {k: copy.deepcopy(v) for k, v in self._vertices.items()}
+        vertex_inputs = {k: list(v) for k, v in self._vertex_inputs.items()}
+        order = topological_sort(vertex_inputs, self._inputs)
+
+        # shape inference + preprocessor auto-insertion + nIn setting, in topo
+        # order (parity with the reference's addPreProcessors + setNIn pass)
+        types: dict = {}
+        if self._input_types is not None:
+            if len(self._input_types) != len(self._inputs):
+                raise ValueError("set_input_types arity != add_inputs arity")
+            types.update(dict(zip(self._inputs, self._input_types)))
+        for name in order:
+            v = vertices[name]
+            v.finalize(self._g)
+            in_types = [types.get(i) for i in vertex_inputs[name]]
+            if any(t is None for t in in_types):
+                continue  # no input types declared; skip inference
+            if isinstance(v, LayerVertex):
+                it = in_types[0]
+                if v.preprocessor is None:
+                    v.preprocessor = default_preprocessor(it, v.layer)
+                if v.preprocessor is not None:
+                    it = v.preprocessor.output_type(it)
+                v.layer.set_n_in(it)
+                v.layer.validate()
+                types[name] = v.layer.output_type(it)
+            else:
+                types[name] = v.output_type(in_types)
+
+        return ComputationGraphConfiguration(
+            network_inputs=list(self._inputs),
+            network_outputs=list(self._outputs),
+            vertices=vertices,
+            vertex_inputs=vertex_inputs,
+            topo_order=order,
+            input_types=self._input_types,
+            seed=self._g.seed,
+            updater=copy.deepcopy(self._g.updater),
+            backprop_type=self._backprop_type,
+            tbptt_fwd_length=self._tbptt_fwd,
+            tbptt_back_length=self._tbptt_back,
+            pretrain=self._pretrain,
+            dtype=self._g.dtype,
+        )

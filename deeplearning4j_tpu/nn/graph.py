@@ -1,0 +1,461 @@
+"""ComputationGraph: DAG-network training stack.
+
+Reference: nn/graph/ComputationGraph.java:83 (3118 LoC) — topological init
+(:358,1084-1186), multi-input/output fit (:753-1030), computeGradientAndScore
+(:1189-1235), vertex-map feedForward (:1247-1290).
+
+TPU-native design mirrors MultiLayerNetwork (nn/multilayer.py): params are a
+pytree ``{vertex_name: {param: Array}}``; one fit iteration — forward over the
+topo-sorted DAG, summed output losses, jax.grad backward, updater — is ONE
+jitted XLA program. Score is the sum of output-layer losses plus regularization
+counted once (parity with ComputationGraph.java:1214-1228).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.graph_conf import (
+    ComputationGraphConfiguration,
+    LayerVertex,
+)
+from deeplearning4j_tpu.nn.conf.layers.misc import CenterLossOutputLayer
+from deeplearning4j_tpu.nn.multilayer import _split_state
+
+
+def _as_list(x):
+    if x is None:
+        return None
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+class ComputationGraph:
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        self.params: dict = {}
+        self.state: dict = {}
+        self.updater_state: dict = {}
+        self.iteration = 0
+        self.epoch = 0
+        self.listeners: list = []
+        self.score_value: float = float("nan")
+        self._step_cache: dict = {}
+        self._output_cache: dict = {}
+        self._rnn_state: Optional[dict] = None
+
+    # ------------------------------------------------------------------ init
+    def init(self, params: Optional[dict] = None) -> "ComputationGraph":
+        dtype = jnp.dtype(self.conf.dtype)
+        rng = jax.random.PRNGKey(self.conf.seed)
+        order = self.conf.topo_order
+        keys = jax.random.split(rng, max(len(order), 1))
+        if params is None:
+            self.params = {name: self.conf.vertices[name].init_params(keys[i], dtype)
+                           for i, name in enumerate(order)}
+        else:
+            self.params = params
+        self.state = {name: self.conf.vertices[name].init_state()
+                      for name in order}
+        self.updater_state = self.conf.updater.init(self.params)
+        return self
+
+    # --------------------------------------------------------------- forward
+    def _forward(self, params, state, inputs, masks, *, train, rng, carry=None,
+                 collect_loss_inputs=False):
+        """Traverse the DAG in topo order.
+
+        inputs/masks: lists parallel to conf.network_inputs. Returns
+        (outputs list, new_states, new_carry, output_masks list, loss_inputs)
+        where loss_inputs[name] is the post-preprocessor input to each output
+        LayerVertex (what its loss head consumes).
+        """
+        conf = self.conf
+        acts: dict = {k: v for k, v in zip(conf.network_inputs, inputs)}
+        act_masks: dict = {k: m for k, m in zip(conf.network_inputs,
+                                                masks or [None] * len(inputs))}
+        ctx = {"input_arrays": dict(acts), "input_masks": dict(act_masks)}
+        new_states: dict = {}
+        new_carry: dict = {}
+        loss_inputs: dict = {}
+        if rng is not None:
+            keys = jax.random.split(rng, max(len(conf.topo_order), 1))
+        for i, name in enumerate(conf.topo_order):
+            v = conf.vertices[name]
+            v_in = [acts[k] for k in conf.vertex_inputs[name]]
+            v_masks = [act_masks.get(k) for k in conf.vertex_inputs[name]]
+            vertex_state = dict(state.get(name, {}))
+            if carry is not None and name in carry:
+                vertex_state.update(carry[name])
+            k = keys[i] if rng is not None else None
+            if (collect_loss_inputs and name in conf.network_outputs
+                    and isinstance(v, LayerVertex)
+                    and hasattr(v.layer, "compute_loss_per_example")):
+                x = v_in[0]
+                if v.preprocessor is not None:
+                    x = v.preprocessor.forward(x)
+                loss_inputs[name] = x
+            out, ns = v.forward(params.get(name, {}), vertex_state, v_in,
+                                masks=v_masks, ctx=ctx, train=train, rng=k)
+            persistent, rnn_carry = _split_state(ns)
+            new_states[name] = persistent
+            if rnn_carry:
+                new_carry[name] = rnn_carry
+            acts[name] = out
+            act_masks[name] = v.feed_forward_mask(v_masks)
+        outs = [acts[o] for o in conf.network_outputs]
+        out_masks = [act_masks.get(o) for o in conf.network_outputs]
+        return outs, new_states, new_carry, out_masks, loss_inputs
+
+    def feed_forward(self, *inputs, train: bool = False):
+        """All vertex activations as {name: array} (reference:
+        ComputationGraph.feedForward :1247-1290)."""
+        conf = self.conf
+        acts = {k: jnp.asarray(v) for k, v in zip(conf.network_inputs, inputs)}
+        ctx = {"input_arrays": dict(acts), "input_masks": {}}
+        for name in conf.topo_order:
+            v = conf.vertices[name]
+            v_in = [acts[k] for k in conf.vertex_inputs[name]]
+            out, _ = v.forward(self.params.get(name, {}),
+                               self.state.get(name, {}), v_in,
+                               masks=None, ctx=ctx, train=train)
+            acts[name] = out
+        return acts
+
+    # ------------------------------------------------------------------ loss
+    def _loss(self, params, state, x, y, input_mask, label_mask, *, train, rng,
+              carry=None):
+        conf = self.conf
+        xs = _as_list(x)
+        ys = _as_list(y)
+        ims = _as_list(input_mask) or [None] * len(xs)
+        lms = _as_list(label_mask) or [None] * len(ys)
+        _, new_states, new_carry, out_masks, loss_inputs = self._forward(
+            params, state, xs, ims, train=train, rng=rng, carry=carry,
+            collect_loss_inputs=True)
+        total = 0.0
+        last_in_by_out = {}
+        for j, name in enumerate(conf.network_outputs):
+            v = conf.vertices[name]
+            if not (isinstance(v, LayerVertex)
+                    and hasattr(v.layer, "compute_loss_per_example")):
+                raise ValueError(f"Output vertex '{name}' has no loss head")
+            last_in = loss_inputs[name]
+            last_in_by_out[name] = last_in
+            if isinstance(v.layer, CenterLossOutputLayer):
+                per_ex = v.layer.compute_loss_per_example(
+                    params[name], last_in, ys[j], state=state.get(name))
+            else:
+                per_ex = v.layer.compute_loss_per_example(params[name], last_in,
+                                                          ys[j])
+            lm = lms[j] if lms[j] is not None else out_masks[j]
+            if lm is not None:
+                lm = lm.reshape(per_ex.shape).astype(per_ex.dtype)
+                total = total + jnp.sum(per_ex * lm) / jnp.maximum(jnp.sum(lm),
+                                                                   1.0)
+            else:
+                total = total + jnp.mean(per_ex)
+            new_states[name] = state.get(name, {})
+        reg = 0.0
+        for name in conf.topo_order:
+            reg = reg + conf.vertices[name].regularization(params.get(name, {}))
+        return total + reg, (new_states, new_carry, last_in_by_out)
+
+    # ------------------------------------------------------------ train step
+    def _lr_mult_tree(self):
+        """Per-leaf LR multipliers honoring per-layer learning_rate overrides
+        (mirrors MultiLayerNetwork._lr_mult_tree)."""
+        base_lr = getattr(self.conf.updater, "learning_rate", None)
+        if not base_lr:
+            return None
+        any_override = False
+        tree: dict = {}
+        for name in self.conf.topo_order:
+            v = self.conf.vertices[name]
+            layer = v.layer if isinstance(v, LayerVertex) else None
+            layer_lr = getattr(layer, "learning_rate", None)
+            bias_lr = getattr(layer, "bias_learning_rate", None)
+            biases = (layer.bias_param_names()
+                      if layer is not None and hasattr(layer, "bias_param_names")
+                      else frozenset())
+            leaf = {}
+            for pname in self.params.get(name, {}):
+                lr = (bias_lr if (pname in biases and bias_lr is not None)
+                      else layer_lr)
+                leaf[pname] = (lr / base_lr) if lr is not None else 1.0
+                if lr is not None:
+                    any_override = True
+            tree[name] = leaf
+        return tree if any_override else None
+
+    def _make_step(self, with_carry: bool):
+        updater = self.conf.updater
+        lr_mults = self._lr_mult_tree()
+        conf = self.conf
+        center_outs = [name for name in conf.network_outputs
+                       if isinstance(conf.vertices[name], LayerVertex)
+                       and isinstance(conf.vertices[name].layer,
+                                      CenterLossOutputLayer)]
+
+        def step(params, opt_state, state, rng, iteration, xs, ys, ims, lms,
+                 carry):
+            def loss_fn(p):
+                return self._loss(p, state, xs, ys, ims, lms, train=True,
+                                  rng=rng, carry=carry if with_carry else None)
+
+            (loss, (new_states, new_carry, last_ins)), grads = \
+                jax.value_and_grad(loss_fn, has_aux=True)(params)
+            if lr_mults is not None:
+                steps, opt_state2 = updater.step(grads, opt_state, iteration,
+                                                 lr_mults)
+            else:
+                steps, opt_state2 = updater.step(grads, opt_state, iteration)
+            new_params = jax.tree_util.tree_map(lambda p, s: p - s, params,
+                                                steps)
+            for name in center_outs:
+                j = conf.network_outputs.index(name)
+                y = ys[j] if isinstance(ys, (list, tuple)) else ys
+                new_states[name] = conf.vertices[name].layer.update_centers(
+                    state[name], last_ins[name], y)
+            return new_params, opt_state2, new_states, new_carry, loss
+
+        return jax.jit(step)
+
+    def _get_step(self, key):
+        if key not in self._step_cache:
+            self._step_cache[key] = self._make_step(with_carry=key[-1])
+        return self._step_cache[key]
+
+    def do_step(self, xs, ys, input_masks=None, label_masks=None, carry=None):
+        """One SGD iteration; returns (loss, new_carry)."""
+        xs = [jnp.asarray(a) for a in _as_list(xs)]
+        ys = [jnp.asarray(a) for a in _as_list(ys)]
+        ims = ([None if m is None else jnp.asarray(m)
+                for m in _as_list(input_masks)] if input_masks is not None
+               else None)
+        lms = ([None if m is None else jnp.asarray(m)
+                for m in _as_list(label_masks)] if label_masks is not None
+               else None)
+        with_carry = carry is not None
+        key = (tuple(a.shape for a in xs), tuple(a.shape for a in ys),
+               ims is not None and any(m is not None for m in ims),
+               lms is not None and any(m is not None for m in lms), with_carry)
+        step = self._get_step(key)
+        rng = jax.random.fold_in(jax.random.PRNGKey(self.conf.seed),
+                                 self.iteration)
+        (self.params, self.updater_state, self.state, new_carry, loss) = step(
+            self.params, self.updater_state, self.state, rng,
+            jnp.asarray(self.iteration, jnp.float32), xs, ys, ims, lms,
+            carry if with_carry else {})
+        self.iteration += 1
+        self.score_value = float(loss)
+        for listener in self.listeners:
+            listener.iteration_done(self, self.iteration)
+        return self.score_value, new_carry
+
+    # ------------------------------------------------------------------- fit
+    def fit(self, data, labels=None, epochs: int = 1):
+        """Train on a DataSet / MultiDataSet / iterator of either (reference:
+        ComputationGraph.fit :753-1030)."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+
+        if labels is not None:
+            data = DataSet(np.asarray(data), np.asarray(labels))
+        if isinstance(data, (DataSet, MultiDataSet)):
+            for _ in range(epochs):
+                self._fit_batch(data)
+            return self
+        for _ in range(epochs):
+            for listener in self.listeners:
+                listener.on_epoch_start(self)
+            if hasattr(data, "reset"):
+                data.reset()
+            for ds in data:
+                self._fit_batch(ds)
+            for listener in self.listeners:
+                listener.on_epoch_end(self)
+            self.epoch += 1
+        return self
+
+    def _fit_batch(self, ds):
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+
+        if isinstance(ds, MultiDataSet):
+            self.do_step(ds.features, ds.labels,
+                         ds.features_masks if any(m is not None
+                                                  for m in ds.features_masks)
+                         else None,
+                         ds.labels_masks if any(m is not None
+                                                for m in ds.labels_masks)
+                         else None)
+            return
+        if (self.conf.backprop_type == "tbptt" and ds.features.ndim == 3
+                and len(self.conf.network_inputs) == 1):
+            self._fit_tbptt(ds)
+        else:
+            self.do_step(ds.features, ds.labels, ds.features_mask,
+                         ds.labels_mask)
+
+    def _fit_tbptt(self, ds):
+        """Truncated BPTT over single-input single-output rnn graphs (reference:
+        ComputationGraph TBPTT path, rnnActivateUsingStoredState :1192-1200)."""
+        T = ds.features.shape[1]
+        L = self.conf.tbptt_fwd_length
+        n_seg = max(1, math.ceil(T / L))
+        carry: dict = {}
+        for s in range(n_seg):
+            sl = slice(s * L, min((s + 1) * L, T))
+            fx = ds.features[:, sl]
+            fy = ds.labels[:, sl] if ds.labels.ndim == 3 else ds.labels
+            fm = ds.features_mask[:, sl] if ds.features_mask is not None else None
+            lm = ds.labels_mask[:, sl] if ds.labels_mask is not None else None
+            _, carry = self.do_step(fx, fy, fm, lm, carry=carry)
+            carry = jax.tree_util.tree_map(jax.lax.stop_gradient, carry)
+
+    # -------------------------------------------------------------- inference
+    def output(self, *inputs, train: bool = False, masks=None):
+        """Output-vertex activations; single output returns the bare array
+        (reference: ComputationGraph.output)."""
+        xs = [jnp.asarray(a) for a in inputs]
+        ms = ([None if m is None else jnp.asarray(m) for m in _as_list(masks)]
+              if masks is not None else [None] * len(xs))
+        key = (tuple(a.shape for a in xs), train,
+               tuple(m is not None for m in ms))
+        if key not in self._output_cache:
+            def fwd(params, state, xs, ms):
+                outs, _, _, _, _ = self._forward(params, state, xs, ms,
+                                                 train=train, rng=None)
+                return outs
+            self._output_cache[key] = jax.jit(fwd)
+        outs = self._output_cache[key](self.params, self.state, xs, ms)
+        return outs[0] if len(outs) == 1 else outs
+
+    def score(self, ds=None, x=None, y=None) -> float:
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+
+        if isinstance(ds, MultiDataSet):
+            x, y = ds.features, ds.labels
+            im = (ds.features_masks if any(m is not None
+                                           for m in ds.features_masks) else None)
+            lm = (ds.labels_masks if any(m is not None
+                                         for m in ds.labels_masks) else None)
+        elif ds is not None:
+            x, y = ds.features, ds.labels
+            im, lm = ds.features_mask, ds.labels_mask
+        else:
+            im = lm = None
+        xs = [jnp.asarray(a) for a in _as_list(x)]
+        ys = [jnp.asarray(a) for a in _as_list(y)]
+        loss, _ = self._loss(
+            self.params, self.state, xs, ys,
+            None if im is None else [None if m is None else jnp.asarray(m)
+                                     for m in _as_list(im)],
+            None if lm is None else [None if m is None else jnp.asarray(m)
+                                     for m in _as_list(lm)],
+            train=False, rng=None)
+        return float(loss)
+
+    def evaluate(self, data, labels=None):
+        """Single-output classification evaluation (reference:
+        ComputationGraph.evaluate)."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.evaluation.classification import Evaluation
+
+        ev = Evaluation()
+        if labels is not None:
+            data = [DataSet(np.asarray(data), np.asarray(labels))]
+        elif isinstance(data, DataSet):
+            data = [data]
+        elif hasattr(data, "reset"):
+            data.reset()
+        for ds in data:
+            out = self.output(ds.features, masks=ds.features_mask)
+            ev.eval(ds.labels, np.asarray(out), mask=ds.labels_mask)
+        return ev
+
+    # -------------------------------------------------------- rnn streaming
+    def rnn_clear_previous_state(self):
+        self._rnn_state = None
+
+    def rnn_time_step(self, *inputs):
+        """Streaming inference with persistent rnn state (reference:
+        ComputationGraph.rnnTimeStep)."""
+        xs = []
+        squeeze = False
+        for x in inputs:
+            x = jnp.asarray(x)
+            if x.ndim == 2:
+                x = x[:, None, :]
+                squeeze = True
+            xs.append(x)
+        carry = self._rnn_state or {}
+        outs, _, new_carry, _, _ = self._forward(
+            self.params, self.state, xs, [None] * len(xs), train=False,
+            rng=None, carry=carry)
+        self._rnn_state = new_carry
+        outs = [o[:, 0] if squeeze and o.ndim == 3 else o for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+    # ------------------------------------------------------- params plumbing
+    def params_flat(self) -> np.ndarray:
+        """Contiguous param vector in (topo order, param_order) order —
+        the graph analogue of MultiLayerNetwork.params()."""
+        chunks = []
+        for name in self.conf.topo_order:
+            v = self.conf.vertices[name]
+            lp = self.params.get(name, {})
+            for pname in v.param_order():
+                if pname in lp:
+                    chunks.append(np.asarray(lp[pname]).ravel())
+        if not chunks:
+            return np.zeros((0,), np.float32)
+        return np.concatenate(chunks)
+
+    def set_params_flat(self, flat) -> None:
+        flat = np.asarray(flat).ravel()
+        off = 0
+        out = {}
+        for name in self.conf.topo_order:
+            v = self.conf.vertices[name]
+            lp = dict(self.params.get(name, {}))
+            for pname in v.param_order():
+                if pname in lp:
+                    tmpl = lp[pname]
+                    n = int(np.prod(tmpl.shape)) if tmpl.shape else 1
+                    lp[pname] = jnp.asarray(
+                        flat[off:off + n].reshape(tmpl.shape),
+                        dtype=tmpl.dtype)
+                    off += n
+            out[name] = lp
+        if off != flat.size:
+            raise ValueError(f"Flat param size {flat.size} != expected {off}")
+        self.params = out
+
+    def num_params(self) -> int:
+        return int(sum(np.prod(v.shape) for lp in self.params.values()
+                       for v in lp.values()))
+
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self
+
+    def add_listener(self, listener):
+        self.listeners.append(listener)
+        return self
+
+    def clone(self) -> "ComputationGraph":
+        import copy
+        net = ComputationGraph(copy.deepcopy(self.conf))
+        net.init()
+        net.params = jax.tree_util.tree_map(lambda a: a, self.params)
+        net.state = jax.tree_util.tree_map(lambda a: a, self.state)
+        net.updater_state = jax.tree_util.tree_map(lambda a: a,
+                                                   self.updater_state)
+        net.iteration = self.iteration
+        return net

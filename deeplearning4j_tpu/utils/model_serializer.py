@@ -49,13 +49,25 @@ def _npz_to_state(data: bytes) -> dict:
     return out
 
 
+def _merge_into(template, loaded):
+    """Recursively overlay loaded leaves onto a freshly-initialised template.
+
+    npz flattening cannot represent *empty* dicts (paramless vertices/layers),
+    so a plain reload would change the pytree structure; overlaying onto the
+    template preserves it."""
+    if not isinstance(template, dict):
+        return loaded if loaded is not None else template
+    out = {}
+    for k, v in template.items():
+        out[k] = _merge_into(v, loaded.get(k) if isinstance(loaded, dict)
+                             else None)
+    return out
+
+
 def save_model(net, path: str, save_updater: bool = True) -> None:
     """Write a MultiLayerNetwork/ComputationGraph to a DL4J-style model zip."""
-    from deeplearning4j_tpu.utils.pytree import flatten_params
-
     conf_json = net.conf.to_json()
-    layers = getattr(net, "layers", None)
-    flat = flatten_params(net.params, layers if isinstance(layers, list) else None)
+    flat = net.params_flat()
     meta = {
         "format_version": 1,
         "model_type": type(net).__name__,
@@ -92,9 +104,9 @@ def load_model(path: str, load_updater: bool = True):
         net = MultiLayerNetwork(conf).init()
     net.set_params_flat(coeff)
     if state:
-        net.state = state
+        net.state = _merge_into(net.state, state)
     if upd is not None:
-        net.updater_state = upd
+        net.updater_state = _merge_into(net.updater_state, upd)
     net.iteration = meta.get("iteration", 0)
     net.epoch = meta.get("epoch", 0)
     return net
