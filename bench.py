@@ -1,0 +1,122 @@
+"""Benchmark driver: one JSON line with the headline metric.
+
+Headline (BASELINE.json "metric"): ResNet50-zoo images/sec/chip, measured by
+training the zoo ResNet50 ComputationGraph on synthetic ImageNet-shaped data
+on the default jax device (the real TPU chip under the driver; CPU when
+forced). Sub-metrics (LeNet-MNIST img/s, TextGenLSTM tokens/s) ride along as
+extra keys in the same JSON object.
+
+vs_baseline: the reference publishes no numbers (BASELINE.md — "published":
+{}), and its Java/Maven stack cannot run here. The denominator is therefore
+the north-star *target* from BASELINE.json: >=70% of nd4j-cuda per-device
+ResNet50 throughput, with the nd4j-cuda-8.0-era figure estimated at 120
+img/s on the 2017 GPUs the reference targeted (K80/GTX1080 class) => target
+84 img/s. vs_baseline = measured / 84.0, i.e. 1.0 means the north star is
+met; >1 beats it.
+
+Usage: python bench.py [model]   (model: resnet50 | lenet | lstm | all;
+default all, headline = resnet50)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+NORTH_STAR_RESNET50_IMG_S = 84.0  # 70% of est. 120 img/s nd4j-cuda
+
+
+def _sync(x):
+    import jax
+    jax.block_until_ready(x)
+
+
+def bench_resnet50(batch: int = 32, steps: int = 10, image: int = 224):
+    """ResNet50 training throughput, img/s (BASELINE config #2)."""
+    from deeplearning4j_tpu.models import ResNet50
+
+    net = ResNet50(num_labels=1000, dtype="float32").init()
+    rs = np.random.RandomState(0)
+    x = rs.randn(batch, image, image, 3).astype(np.float32)
+    y = np.eye(1000, dtype=np.float32)[rs.randint(0, 1000, batch)]
+    net.do_step(x, y)  # compile
+    _sync(net.params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        net.do_step(x, y)
+    _sync(net.params)
+    dt = time.perf_counter() - t0
+    return batch * steps / dt
+
+
+def bench_lenet(batch: int = 512, steps: int = 20):
+    """LeNet-MNIST training throughput, img/s (BASELINE config #1)."""
+    from deeplearning4j_tpu.models import LeNet
+
+    net = LeNet(num_labels=10).init()
+    rs = np.random.RandomState(1)
+    x = rs.randn(batch, 28, 28, 1).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rs.randint(0, 10, batch)]
+    net.do_step(x, y)
+    _sync(net.params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        net.do_step(x, y)
+    _sync(net.params)
+    return batch * steps / (time.perf_counter() - t0)
+
+
+def bench_lstm(batch: int = 64, seq: int = 50, vocab: int = 77,
+               steps: int = 10):
+    """GravesLSTM char-RNN training throughput, tokens/s (BASELINE config #3)."""
+    from deeplearning4j_tpu.models import TextGenerationLSTM
+
+    net = TextGenerationLSTM(num_labels=vocab, max_length=seq).init()
+    rs = np.random.RandomState(2)
+    idx = rs.randint(0, vocab, (batch, seq))
+    x = np.eye(vocab, dtype=np.float32)[idx]
+    y = np.eye(vocab, dtype=np.float32)[rs.randint(0, vocab, (batch, seq))]
+    net.do_step(x, y)
+    _sync(net.params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        net.do_step(x, y)
+    _sync(net.params)
+    return batch * seq * steps / (time.perf_counter() - t0)
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    valid = ("all", "resnet50", "lenet", "lstm")
+    if which not in valid:
+        sys.exit(f"Unknown model '{which}'; choose one of {valid}")
+    extras = {}
+    if which in ("all", "lenet"):
+        extras["lenet_mnist_img_s"] = round(bench_lenet(), 1)
+        print(f"# lenet {extras['lenet_mnist_img_s']} img/s", file=sys.stderr)
+    if which in ("all", "lstm"):
+        extras["textgen_lstm_tokens_s"] = round(bench_lstm(), 1)
+        print(f"# lstm {extras['textgen_lstm_tokens_s']} tok/s",
+              file=sys.stderr)
+    if which in ("all", "resnet50"):
+        v = bench_resnet50()
+        result = {
+            "metric": "resnet50_img_per_sec_per_chip",
+            "value": round(v, 2),
+            "unit": "img/s",
+            "vs_baseline": round(v / NORTH_STAR_RESNET50_IMG_S, 3),
+            **extras,
+        }
+    else:
+        k, v = next(iter(extras.items()))
+        result = {"metric": k, "value": v,
+                  "unit": "img/s" if "img" in k else "tokens/s",
+                  "vs_baseline": float("nan")}
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

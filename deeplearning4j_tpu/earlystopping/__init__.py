@@ -1,0 +1,50 @@
+"""Early stopping (reference: deeplearning4j-nn earlystopping/, 22 files).
+
+- ``EarlyStoppingConfiguration`` — conditions + score calculator + saver
+  (reference: earlystopping/EarlyStoppingConfiguration.java builder)
+- termination conditions (reference: earlystopping/termination/*)
+- ``DataSetLossCalculator`` (reference: scorecalc/DataSetLossCalculator.java,
+  DataSetLossCalculatorCG.java — one class here, both net types share score())
+- savers (reference: saver/InMemoryModelSaver.java, LocalFileModelSaver.java)
+- ``EarlyStoppingTrainer`` — the fit loop (reference:
+  trainer/BaseEarlyStoppingTrainer.java:76-220). Works for MultiLayerNetwork
+  and ComputationGraph alike (the reference needs a separate
+  EarlyStoppingGraphTrainer; here both expose the same fit/score contract).
+"""
+
+from deeplearning4j_tpu.earlystopping.config import (
+    EarlyStoppingConfiguration,
+    EarlyStoppingResult,
+)
+from deeplearning4j_tpu.earlystopping.savers import (
+    InMemoryModelSaver,
+    LocalFileModelSaver,
+)
+from deeplearning4j_tpu.earlystopping.scorecalc import (
+    DataSetLossCalculator,
+    EvaluationScoreCalculator,
+)
+from deeplearning4j_tpu.earlystopping.termination import (
+    BestScoreEpochTerminationCondition,
+    InvalidScoreIterationTerminationCondition,
+    MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+)
+from deeplearning4j_tpu.earlystopping.trainer import (
+    EarlyStoppingGraphTrainer,
+    EarlyStoppingTrainer,
+)
+
+__all__ = [
+    "EarlyStoppingConfiguration", "EarlyStoppingResult",
+    "InMemoryModelSaver", "LocalFileModelSaver",
+    "DataSetLossCalculator", "EvaluationScoreCalculator",
+    "MaxEpochsTerminationCondition", "BestScoreEpochTerminationCondition",
+    "ScoreImprovementEpochTerminationCondition",
+    "MaxTimeIterationTerminationCondition",
+    "MaxScoreIterationTerminationCondition",
+    "InvalidScoreIterationTerminationCondition",
+    "EarlyStoppingTrainer", "EarlyStoppingGraphTrainer",
+]

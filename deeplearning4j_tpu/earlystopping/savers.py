@@ -1,0 +1,75 @@
+"""Model savers (reference: earlystopping/saver/).
+
+``InMemoryModelSaver`` keeps a clone; ``LocalFileModelSaver`` writes
+bestModel.bin / latestModel.bin zips via the model serializer (reference:
+LocalFileModelSaver.java:44-55 uses the same two file names).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+class EarlyStoppingModelSaver:
+    def save_best_model(self, net, score: float) -> None:
+        raise NotImplementedError
+
+    def save_latest_model(self, net, score: float) -> None:
+        raise NotImplementedError
+
+    def get_best_model(self):
+        raise NotImplementedError
+
+    def get_latest_model(self):
+        raise NotImplementedError
+
+
+class InMemoryModelSaver(EarlyStoppingModelSaver):
+    """reference: saver/InMemoryModelSaver.java"""
+
+    def __init__(self):
+        self._best = None
+        self._latest = None
+
+    def save_best_model(self, net, score: float) -> None:
+        self._best = net.clone()
+
+    def save_latest_model(self, net, score: float) -> None:
+        self._latest = net.clone()
+
+    def get_best_model(self):
+        return self._best
+
+    def get_latest_model(self):
+        return self._latest
+
+
+class LocalFileModelSaver(EarlyStoppingModelSaver):
+    """reference: saver/LocalFileModelSaver.java (bestModel.bin /
+    latestModel.bin in a directory). Files are our model zips."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.directory, name)
+
+    def save_best_model(self, net, score: float) -> None:
+        from deeplearning4j_tpu.utils.model_serializer import save_model
+        save_model(net, self._path("bestModel.bin"))
+
+    def save_latest_model(self, net, score: float) -> None:
+        from deeplearning4j_tpu.utils.model_serializer import save_model
+        save_model(net, self._path("latestModel.bin"))
+
+    def _load(self, name: str):
+        from deeplearning4j_tpu.utils.model_serializer import load_model
+        p = self._path(name)
+        return load_model(p) if os.path.exists(p) else None
+
+    def get_best_model(self):
+        return self._load("bestModel.bin")
+
+    def get_latest_model(self):
+        return self._load("latestModel.bin")

@@ -1,0 +1,46 @@
+"""Score calculators (reference: earlystopping/scorecalc/).
+
+``DataSetLossCalculator`` averages the model loss over a validation iterator
+(reference: DataSetLossCalculator.java — example- or batch-averaged; and
+DataSetLossCalculatorCG.java — one class covers both net types here since
+MultiLayerNetwork and ComputationGraph share score()).
+"""
+
+from __future__ import annotations
+
+
+class ScoreCalculator:
+    def calculate_score(self, net) -> float:
+        raise NotImplementedError
+
+
+class DataSetLossCalculator(ScoreCalculator):
+    def __init__(self, iterator, average: bool = True):
+        self.iterator = iterator
+        self.average = average
+
+    def calculate_score(self, net) -> float:
+        if hasattr(self.iterator, "reset"):
+            self.iterator.reset()
+        total = 0.0
+        n = 0
+        for ds in self.iterator:
+            b = ds.num_examples()
+            total += net.score(ds) * (b if self.average else 1.0)
+            n += b if self.average else 1
+        return total / max(n, 1)
+
+
+class EvaluationScoreCalculator(ScoreCalculator):
+    """Score = 1 - accuracy on a validation iterator, so 'minimize score'
+    maximizes accuracy (the reference gained this class post-0.8; provided for
+    API completeness)."""
+
+    def __init__(self, iterator):
+        self.iterator = iterator
+
+    def calculate_score(self, net) -> float:
+        if hasattr(self.iterator, "reset"):
+            self.iterator.reset()
+        ev = net.evaluate(self.iterator)
+        return 1.0 - ev.accuracy()

@@ -1,0 +1,22 @@
+"""Model zoo (reference: deeplearning4j-zoo)."""
+
+from deeplearning4j_tpu.models.zoo import (
+    AlexNet,
+    FaceNetNN4Small2,
+    GoogLeNet,
+    InceptionResNetV1,
+    LeNet,
+    ResNet50,
+    SimpleCNN,
+    TextGenerationLSTM,
+    VGG16,
+    VGG19,
+    ZooModel,
+    zoo_models,
+)
+
+__all__ = [
+    "AlexNet", "FaceNetNN4Small2", "GoogLeNet", "InceptionResNetV1", "LeNet",
+    "ResNet50", "SimpleCNN", "TextGenerationLSTM", "VGG16", "VGG19",
+    "ZooModel", "zoo_models",
+]

@@ -1,0 +1,760 @@
+"""Model zoo: instantiable standard architectures.
+
+Reference: deeplearning4j-zoo/src/main/java/org/deeplearning4j/zoo/ —
+ZooModel.java:23 (abstract model), InstantiableModel.java:9, and the ten
+models under zoo/model/. Architectures and hyperparameters follow the
+reference files (cited per class); layouts are TPU-first (NHWC images,
+[B,T,F] sequences) and every model compiles to a single XLA program through
+MultiLayerNetwork / ComputationGraph.
+
+Divergences from the reference, by design:
+- ``init_pretrained`` raises: the reference downloads pretrained zips from
+  blob.deeplearning4j.org (ZooModel.java:40-52); this environment has no
+  egress. Weights can instead be restored from a local model zip.
+- GoogLeNet's head uses global average pooling instead of the reference's
+  fixed 7x7 average pool (GoogLeNet.java:114 assumes a 7x7 feature map that
+  its own downsampling stack never produces — a known bug in that vintage).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graph_conf import ElementWiseVertex, MergeVertex
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers.convolution import (
+    ConvolutionLayer,
+    SubsamplingLayer,
+    ZeroPaddingLayer,
+)
+from deeplearning4j_tpu.nn.conf.layers.core import (
+    ActivationLayer,
+    DenseLayer,
+    DropoutLayer,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.conf.layers.misc import CenterLossOutputLayer
+from deeplearning4j_tpu.nn.conf.layers.normalization import (
+    BatchNormalization,
+    LocalResponseNormalization,
+)
+from deeplearning4j_tpu.nn.conf.layers.pooling import GlobalPoolingLayer
+from deeplearning4j_tpu.nn.conf.layers.recurrent import (
+    GravesLSTM,
+    RnnOutputLayer,
+)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import (
+    AdaDelta,
+    Nesterovs,
+    RmsProp,
+)
+from deeplearning4j_tpu.nn.weights import Distribution
+
+
+class ZooModel:
+    """Base for instantiable zoo models (reference: zoo/ZooModel.java:23,
+    zoo/InstantiableModel.java:9).
+
+    ``input_shape`` is (height, width, channels) — NHWC, unlike the
+    reference's (channels, height, width).
+    """
+
+    def __init__(self, num_labels: int = 1000, seed: int = 123,
+                 input_shape: Optional[tuple] = None, dtype: str = "float32"):
+        self.num_labels = num_labels
+        self.seed = seed
+        self.dtype = dtype
+        if input_shape is not None:
+            self.input_shape = tuple(input_shape)
+
+    def conf(self):
+        raise NotImplementedError
+
+    def init(self):
+        c = self.conf()
+        net = (ComputationGraph(c)
+               if type(c).__name__ == "ComputationGraphConfiguration"
+               else MultiLayerNetwork(c))
+        return net.init()
+
+    def init_pretrained(self, pretrained_type: str = "imagenet"):
+        raise NotImplementedError(
+            "Pretrained weights require network access (reference downloads "
+            "from blob.deeplearning4j.org, ZooModel.java:40-52). Restore from "
+            "a local zip via utils.model_serializer.load_model instead.")
+
+    def model_type(self) -> str:
+        return "MultiLayerNetwork"
+
+
+class LeNet(ZooModel):
+    """LeNet-5 for MNIST (reference: zoo/model/LeNet.java:31,79-108).
+    conv5x5(20) -> max2 -> conv5x5(50) -> max2 -> dense500 -> softmax."""
+
+    input_shape = (28, 28, 1)
+
+    def __init__(self, num_labels: int = 10, **kw):
+        super().__init__(num_labels=num_labels, **kw)
+
+    def conf(self):
+        h, w, c = self.input_shape
+        return (NeuralNetConfiguration.builder()
+                .seed(self.seed).activation("identity").weight_init("xavier")
+                .updater(AdaDelta()).dtype(self.dtype)
+                .list(
+                    ConvolutionLayer(name="cnn1", n_out=20, kernel_size=(5, 5),
+                                     stride=(1, 1), convolution_mode="same",
+                                     activation="relu"),
+                    SubsamplingLayer(name="maxpool1", kernel_size=(2, 2),
+                                     stride=(2, 2)),
+                    ConvolutionLayer(name="cnn2", n_out=50, kernel_size=(5, 5),
+                                     stride=(1, 1), convolution_mode="same",
+                                     activation="relu"),
+                    SubsamplingLayer(name="maxpool2", kernel_size=(2, 2),
+                                     stride=(2, 2)),
+                    DenseLayer(name="ffn1", n_out=500, activation="relu"),
+                    OutputLayer(name="output", n_out=self.num_labels,
+                                activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.convolutional(h, w, c))
+                .build())
+
+
+class SimpleCNN(ZooModel):
+    """Five conv/BN blocks + global-avg-pool head (reference:
+    zoo/model/SimpleCNN.java:71-131)."""
+
+    input_shape = (48, 48, 1)
+
+    def __init__(self, num_labels: int = 10, **kw):
+        super().__init__(num_labels=num_labels, **kw)
+
+    def conf(self):
+        h, w, c = self.input_shape
+
+        def block(k, n, drop=True):
+            layers = [
+                ConvolutionLayer(n_out=n, kernel_size=(k, k),
+                                 convolution_mode="same"),
+                BatchNormalization(),
+                ConvolutionLayer(n_out=n, kernel_size=(k, k),
+                                 convolution_mode="same"),
+                BatchNormalization(),
+                ActivationLayer(activation="relu"),
+                SubsamplingLayer(pooling_type="avg", kernel_size=(2, 2),
+                                 stride=(2, 2)),
+            ]
+            if drop:
+                layers.append(DropoutLayer(dropout=0.5))
+            return layers
+
+        layers = (block(7, 16) + block(5, 32) + block(3, 64) + block(3, 128)
+                  + [ConvolutionLayer(n_out=256, kernel_size=(3, 3),
+                                      convolution_mode="same"),
+                     BatchNormalization(),
+                     ConvolutionLayer(n_out=self.num_labels,
+                                      kernel_size=(3, 3),
+                                      convolution_mode="same"),
+                     GlobalPoolingLayer(pooling_type="avg"),
+                     ActivationLayer(activation="softmax"),
+                     # loss head over the softmaxed pooled logits
+                     ])
+        # The reference ends at the softmax ActivationLayer (SimpleCNN.java:
+        # 124-126) and trains via an external loss; here we make the net
+        # trainable standalone by using an OutputLayer head instead of the
+        # last Activation+GlobalPooling pair.
+        layers = layers[:-2] + [GlobalPoolingLayer(pooling_type="avg"),
+                                OutputLayer(n_out=self.num_labels,
+                                            activation="softmax",
+                                            loss="mcxent")]
+        return (NeuralNetConfiguration.builder()
+                .seed(self.seed).activation("identity").weight_init("relu")
+                .updater(AdaDelta()).dtype(self.dtype)
+                .list(*layers)
+                .set_input_type(InputType.convolutional(h, w, c))
+                .build())
+
+
+class AlexNet(ZooModel):
+    """AlexNet, one-tower variant (reference: zoo/model/AlexNet.java:41,88-140).
+    Keeps the reference's (quirky) strides so layer shapes match."""
+
+    input_shape = (224, 224, 3)
+
+    def conf(self):
+        h, w, c = self.input_shape
+        non_zero_bias = 1.0
+        return (NeuralNetConfiguration.builder()
+                .seed(self.seed).activation("relu")
+                .weight_init("distribution")
+                .dist(Distribution.normal(0.0, 0.01))
+                .updater(Nesterovs(learning_rate=1e-2, momentum=0.9))
+                .l2(5e-4).dtype(self.dtype)
+                .list(
+                    ConvolutionLayer(name="cnn1", n_out=64,
+                                     kernel_size=(11, 11), stride=(4, 4),
+                                     padding=(2, 2),
+                                     convolution_mode="truncate"),
+                    SubsamplingLayer(name="maxpool1", kernel_size=(3, 3),
+                                     stride=(2, 2), padding=(1, 1),
+                                     convolution_mode="truncate"),
+                    ConvolutionLayer(name="cnn2", n_out=192,
+                                     kernel_size=(5, 5), stride=(2, 2),
+                                     padding=(2, 2),
+                                     convolution_mode="truncate",
+                                     bias_init=non_zero_bias),
+                    SubsamplingLayer(name="maxpool2", kernel_size=(3, 3),
+                                     stride=(2, 2)),
+                    ConvolutionLayer(name="cnn3", n_out=384,
+                                     kernel_size=(3, 3), stride=(1, 1),
+                                     padding=(1, 1)),
+                    ConvolutionLayer(name="cnn4", n_out=256,
+                                     kernel_size=(3, 3), stride=(1, 1),
+                                     padding=(1, 1), bias_init=non_zero_bias),
+                    ConvolutionLayer(name="cnn5", n_out=256,
+                                     kernel_size=(3, 3), stride=(1, 1),
+                                     padding=(1, 1), bias_init=non_zero_bias),
+                    SubsamplingLayer(name="maxpool3", kernel_size=(3, 3),
+                                     stride=(7, 7)),
+                    DenseLayer(name="ffn1", n_out=4096,
+                               dist=Distribution.normal(0, 0.005),
+                               weight_init="distribution",
+                               bias_init=non_zero_bias, dropout=0.5),
+                    DenseLayer(name="ffn2", n_out=4096,
+                               dist=Distribution.normal(0, 0.005),
+                               weight_init="distribution",
+                               bias_init=non_zero_bias, dropout=0.5),
+                    OutputLayer(name="output", n_out=self.num_labels,
+                                activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.convolutional(h, w, c))
+                .build())
+
+
+def _vgg_convs(*spec):
+    """spec: sequence of channel counts; 'M' inserts a 2x2 max pool."""
+    layers = []
+    for s in spec:
+        if s == "M":
+            layers.append(SubsamplingLayer(pooling_type="max",
+                                           kernel_size=(2, 2), stride=(2, 2)))
+        else:
+            layers.append(ConvolutionLayer(n_out=s, kernel_size=(3, 3),
+                                           stride=(1, 1), padding=(1, 1)))
+    return layers
+
+
+class VGG16(ZooModel):
+    """VGG-16 (reference: zoo/model/VGG16.java:35,91-160; conv-only head as in
+    the reference, which comments out the 4096 dense layers)."""
+
+    input_shape = (224, 224, 3)
+
+    def conf(self):
+        h, w, c = self.input_shape
+        convs = _vgg_convs(64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+                           512, 512, 512, "M", 512, 512, 512, "M")
+        return (NeuralNetConfiguration.builder()
+                .seed(self.seed).activation("relu")
+                .updater(Nesterovs(learning_rate=1e-2, momentum=0.9))
+                .dtype(self.dtype)
+                .list(*convs,
+                      OutputLayer(name="output", n_out=self.num_labels,
+                                  activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.convolutional(h, w, c))
+                .build())
+
+
+class VGG19(ZooModel):
+    """VGG-19 (reference: zoo/model/VGG19.java)."""
+
+    input_shape = (224, 224, 3)
+
+    def conf(self):
+        h, w, c = self.input_shape
+        convs = _vgg_convs(64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+                           512, 512, 512, 512, "M", 512, 512, 512, 512, "M")
+        return (NeuralNetConfiguration.builder()
+                .seed(self.seed).activation("relu")
+                .updater(Nesterovs(learning_rate=1e-2, momentum=0.9))
+                .dtype(self.dtype)
+                .list(*convs,
+                      OutputLayer(name="output", n_out=self.num_labels,
+                                  activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.convolutional(h, w, c))
+                .build())
+
+
+class ResNet50(ZooModel):
+    """ResNet-50 as a ComputationGraph (reference: zoo/model/ResNet50.java:
+    33,82 graphBuilder, :91-125 identityBlock, :128-172 convBlock). The
+    residual blocks are ElementWiseVertex(add) joins — on TPU the whole graph
+    is one XLA program; BN+ReLU fuse into the convolutions."""
+
+    input_shape = (224, 224, 3)
+
+    def _conv_bn_act(self, g, name, n_out, kernel, stride, mode, input_name,
+                     act="relu"):
+        g.add_layer(name, ConvolutionLayer(n_out=n_out, kernel_size=kernel,
+                                           stride=stride,
+                                           convolution_mode=mode), input_name)
+        g.add_layer(name + "_bn", BatchNormalization(), name)
+        if act is None:
+            return name + "_bn"
+        g.add_layer(name + "_act", ActivationLayer(activation=act),
+                    name + "_bn")
+        return name + "_act"
+
+    def _identity_block(self, g, kernel, filters, stage, block, input_name):
+        n = f"res{stage}{block}"
+        f1, f2, f3 = filters
+        a = self._conv_bn_act(g, n + "_2a", f1, (1, 1), (1, 1), "truncate",
+                              input_name)
+        b = self._conv_bn_act(g, n + "_2b", f2, kernel, (1, 1), "same", a)
+        c = self._conv_bn_act(g, n + "_2c", f3, (1, 1), (1, 1), "truncate", b,
+                              act=None)
+        g.add_vertex(n + "_add", ElementWiseVertex(op="add"), c, input_name)
+        g.add_layer(n, ActivationLayer(activation="relu"), n + "_add")
+        return n
+
+    def _conv_block(self, g, kernel, filters, stage, block, stride,
+                    input_name):
+        n = f"res{stage}{block}"
+        f1, f2, f3 = filters
+        a = self._conv_bn_act(g, n + "_2a", f1, (1, 1), stride, "truncate",
+                              input_name)
+        b = self._conv_bn_act(g, n + "_2b", f2, kernel, (1, 1), "same", a)
+        c = self._conv_bn_act(g, n + "_2c", f3, (1, 1), (1, 1), "truncate", b,
+                              act=None)
+        s = self._conv_bn_act(g, n + "_1", f3, (1, 1), stride, "truncate",
+                              input_name, act=None)
+        g.add_vertex(n + "_add", ElementWiseVertex(op="add"), c, s)
+        g.add_layer(n, ActivationLayer(activation="relu"), n + "_add")
+        return n
+
+    def conf(self):
+        h, w, c = self.input_shape
+        g = (NeuralNetConfiguration.builder()
+             .seed(self.seed).activation("identity")
+             .updater(RmsProp(learning_rate=0.1, rms_decay=0.96, epsilon=0.001))
+             .weight_init("distribution").dist(Distribution.normal(0.0, 0.5))
+             .l1(1e-7).l2(5e-5).dtype(self.dtype)
+             .graph_builder()
+             .add_inputs("input"))
+        g.add_layer("stem_zero", ZeroPaddingLayer(pad_top=3, pad_bottom=3,
+                                                  pad_left=3, pad_right=3),
+                    "input")
+        stem = self._conv_bn_act(g, "stem_cnn1", 64, (7, 7), (2, 2),
+                                 "truncate", "stem_zero")
+        g.add_layer("stem_maxpool1",
+                    SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                     stride=(2, 2)), stem)
+
+        x = self._conv_block(g, (3, 3), (64, 64, 256), 2, "a", (2, 2),
+                             "stem_maxpool1")
+        x = self._identity_block(g, (3, 3), (64, 64, 256), 2, "b", x)
+        x = self._identity_block(g, (3, 3), (64, 64, 256), 2, "c", x)
+
+        x = self._conv_block(g, (3, 3), (128, 128, 512), 3, "a", (2, 2), x)
+        for blk in "bcd":
+            x = self._identity_block(g, (3, 3), (128, 128, 512), 3, blk, x)
+
+        x = self._conv_block(g, (3, 3), (256, 256, 1024), 4, "a", (2, 2), x)
+        for blk in "bcdef":
+            x = self._identity_block(g, (3, 3), (256, 256, 1024), 4, blk, x)
+
+        x = self._conv_block(g, (3, 3), (512, 512, 2048), 5, "a", (2, 2), x)
+        x = self._identity_block(g, (3, 3), (512, 512, 2048), 5, "b", x)
+        x = self._identity_block(g, (3, 3), (512, 512, 2048), 5, "c", x)
+
+        g.add_layer("avgpool", GlobalPoolingLayer(pooling_type="avg"), x)
+        g.add_layer("output", OutputLayer(n_out=self.num_labels,
+                                          activation="softmax", loss="mcxent"),
+                    "avgpool")
+        g.set_outputs("output")
+        g.set_input_types(InputType.convolutional(h, w, c))
+        return g.build()
+
+    def model_type(self) -> str:
+        return "ComputationGraph"
+
+
+class GoogLeNet(ZooModel):
+    """GoogLeNet / Inception-v1 as a ComputationGraph (reference:
+    zoo/model/GoogLeNet.java:84-96 inception, :99-175 conf)."""
+
+    input_shape = (224, 224, 3)
+
+    def _inception(self, g, name, config, input_name):
+        (c1,), (c3r, c3), (c5r, c5), (pp,) = config
+        g.add_layer(f"{name}-cnn1",
+                    ConvolutionLayer(n_out=c1, kernel_size=(1, 1),
+                                     bias_init=0.2, activation="relu"),
+                    input_name)
+        g.add_layer(f"{name}-cnn2",
+                    ConvolutionLayer(n_out=c3r, kernel_size=(1, 1),
+                                     bias_init=0.2, activation="relu"),
+                    input_name)
+        g.add_layer(f"{name}-cnn3",
+                    ConvolutionLayer(n_out=c5r, kernel_size=(1, 1),
+                                     bias_init=0.2, activation="relu"),
+                    input_name)
+        g.add_layer(f"{name}-max1",
+                    SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                     stride=(1, 1), padding=(1, 1)),
+                    input_name)
+        g.add_layer(f"{name}-cnn4",
+                    ConvolutionLayer(n_out=c3, kernel_size=(3, 3),
+                                     padding=(1, 1), bias_init=0.2,
+                                     activation="relu"), f"{name}-cnn2")
+        g.add_layer(f"{name}-cnn5",
+                    ConvolutionLayer(n_out=c5, kernel_size=(5, 5),
+                                     padding=(2, 2), bias_init=0.2,
+                                     activation="relu"), f"{name}-cnn3")
+        g.add_layer(f"{name}-cnn6",
+                    ConvolutionLayer(n_out=pp, kernel_size=(1, 1),
+                                     bias_init=0.2, activation="relu"),
+                    f"{name}-max1")
+        g.add_vertex(f"{name}-depthconcat1", MergeVertex(), f"{name}-cnn1",
+                     f"{name}-cnn4", f"{name}-cnn5", f"{name}-cnn6")
+        return f"{name}-depthconcat1"
+
+    def conf(self):
+        h, w, c = self.input_shape
+        g = (NeuralNetConfiguration.builder()
+             .seed(self.seed).activation("relu").weight_init("xavier")
+             .updater(Nesterovs(learning_rate=1e-2, momentum=0.9))
+             .l2(2e-4).dtype(self.dtype)
+             .graph_builder()
+             .add_inputs("input"))
+        g.add_layer("cnn1", ConvolutionLayer(n_out=64, kernel_size=(7, 7),
+                                             stride=(2, 2), padding=(3, 3),
+                                             bias_init=0.2), "input")
+        g.add_layer("max1", SubsamplingLayer(pooling_type="max",
+                                             kernel_size=(3, 3),
+                                             stride=(2, 2), padding=(1, 1)),
+                    "cnn1")
+        g.add_layer("lrn1", LocalResponseNormalization(n=5, alpha=1e-4,
+                                                       beta=0.75), "max1")
+        g.add_layer("cnn2", ConvolutionLayer(n_out=64, kernel_size=(1, 1),
+                                             bias_init=0.2), "lrn1")
+        g.add_layer("cnn3", ConvolutionLayer(n_out=192, kernel_size=(3, 3),
+                                             padding=(1, 1), bias_init=0.2),
+                    "cnn2")
+        g.add_layer("lrn2", LocalResponseNormalization(n=5, alpha=1e-4,
+                                                       beta=0.75), "cnn3")
+        g.add_layer("max2", SubsamplingLayer(pooling_type="max",
+                                             kernel_size=(3, 3),
+                                             stride=(2, 2), padding=(1, 1)),
+                    "lrn2")
+        x = self._inception(g, "3a", ((64,), (96, 128), (16, 32), (32,)),
+                            "max2")
+        x = self._inception(g, "3b", ((128,), (128, 192), (32, 96), (64,)), x)
+        g.add_layer("max3", SubsamplingLayer(pooling_type="max",
+                                             kernel_size=(3, 3),
+                                             stride=(2, 2), padding=(1, 1)),
+                    x)
+        x = self._inception(g, "4a", ((192,), (96, 208), (16, 48), (64,)),
+                            "max3")
+        x = self._inception(g, "4b", ((160,), (112, 224), (24, 64), (64,)), x)
+        x = self._inception(g, "4c", ((128,), (128, 256), (24, 64), (64,)), x)
+        x = self._inception(g, "4d", ((112,), (144, 288), (32, 64), (64,)), x)
+        x = self._inception(g, "4e", ((256,), (160, 320), (32, 128), (128,)),
+                            x)
+        g.add_layer("max4", SubsamplingLayer(pooling_type="max",
+                                             kernel_size=(3, 3),
+                                             stride=(2, 2), padding=(1, 1)),
+                    x)
+        x = self._inception(g, "5a", ((256,), (160, 320), (32, 128), (128,)),
+                            "max4")
+        x = self._inception(g, "5b", ((384,), (192, 384), (48, 128), (128,)),
+                            x)
+        g.add_layer("avg3", GlobalPoolingLayer(pooling_type="avg"), x)
+        g.add_layer("fc1", DenseLayer(n_out=1024, dropout=0.4), "avg3")
+        g.add_layer("output", OutputLayer(n_out=self.num_labels,
+                                          activation="softmax", loss="mcxent"),
+                    "fc1")
+        g.set_outputs("output")
+        g.set_input_types(InputType.convolutional(h, w, c))
+        return g.build()
+
+    def model_type(self) -> str:
+        return "ComputationGraph"
+
+
+class FaceNetNN4Small2(ZooModel):
+    """FaceNet NN4.small2 embedding net with center-loss head (reference:
+    zoo/model/FaceNetNN4Small2.java:80-340 — stem, inception-2..5 blocks,
+    avg-pool, bottleneck dense, CenterLossOutputLayer). Inception internals
+    follow zoo/model/helper/FaceNetHelper.appendGraph."""
+
+    input_shape = (96, 96, 3)
+    embedding_size = 128
+
+    def __init__(self, num_labels: int = 1000, **kw):
+        super().__init__(num_labels=num_labels, **kw)
+
+    def _conv_bn(self, g, name, n_out, kernel, stride, pad, input_name):
+        g.add_layer(name, ConvolutionLayer(n_out=n_out, kernel_size=kernel,
+                                           stride=stride, padding=pad),
+                    input_name)
+        g.add_layer(name + "_bn", BatchNormalization(), name)
+        g.add_layer(name + "_act", ActivationLayer(activation="relu"),
+                    name + "_bn")
+        return name + "_act"
+
+    def _inception(self, g, name, reduce_sizes, out_sizes, input_name):
+        """4 branches: 1x1, 1x1->3x3, 1x1->5x5, pool->1x1 (FaceNetHelper);
+        reduce_sizes = (3x3-reduce, 5x5-reduce, pool-proj, 1x1)."""
+        r3, r5, p1, c1 = reduce_sizes
+        c3, c5 = out_sizes
+        branches = []
+        if c1:
+            branches.append(self._conv_bn(g, f"{name}-1x1", c1, (1, 1),
+                                          (1, 1), (0, 0), input_name))
+        a = self._conv_bn(g, f"{name}-3x3r", r3, (1, 1), (1, 1), (0, 0),
+                          input_name)
+        branches.append(self._conv_bn(g, f"{name}-3x3", c3, (3, 3), (1, 1),
+                                      (1, 1), a))
+        if r5 and c5:  # reference 5a block omits the 5x5 branch
+            b = self._conv_bn(g, f"{name}-5x5r", r5, (1, 1), (1, 1), (0, 0),
+                              input_name)
+            branches.append(self._conv_bn(g, f"{name}-5x5", c5, (5, 5),
+                                          (1, 1), (2, 2), b))
+        g.add_layer(f"{name}-pool",
+                    SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                     stride=(1, 1), padding=(1, 1)),
+                    input_name)
+        branches.append(self._conv_bn(g, f"{name}-poolproj", p1, (1, 1),
+                                      (1, 1), (0, 0), f"{name}-pool"))
+        g.add_vertex(f"{name}-merge", MergeVertex(), *branches)
+        return f"{name}-merge"
+
+    def conf(self):
+        h, w, c = self.input_shape
+        g = (NeuralNetConfiguration.builder()
+             .seed(self.seed).activation("relu").weight_init("relu")
+             .updater(Nesterovs(learning_rate=1e-3, momentum=0.9))
+             .dtype(self.dtype)
+             .graph_builder()
+             .add_inputs("input"))
+        x = self._conv_bn(g, "stem-cnn1", 64, (7, 7), (2, 2), (3, 3), "input")
+        g.add_layer("stem-pool1",
+                    SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                     stride=(2, 2), padding=(1, 1)), x)
+        x = self._conv_bn(g, "inception-2-cnn1", 64, (1, 1), (1, 1), (0, 0),
+                          "stem-pool1")
+        x = self._conv_bn(g, "inception-2-cnn2", 192, (3, 3), (1, 1), (1, 1),
+                          x)
+        g.add_layer("inception-2-pool1",
+                    SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                     stride=(2, 2), padding=(1, 1)), x)
+        x = self._inception(g, "3a", (96, 16, 32, 64), (128, 32),
+                            "inception-2-pool1")
+        x = self._inception(g, "3b", (96, 32, 64, 64), (128, 64), x)
+        g.add_layer("3c-pool",
+                    SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                     stride=(2, 2), padding=(1, 1)), x)
+        x = self._inception(g, "4a", (96, 32, 128, 256), (192, 64),
+                            "3c-pool")
+        g.add_layer("4e-pool",
+                    SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                     stride=(2, 2), padding=(1, 1)), x)
+        x = self._inception(g, "5a", (96, 0, 96, 256), (384, 0), "4e-pool")
+        g.add_layer("avgpool", GlobalPoolingLayer(pooling_type="avg"), x)
+        g.add_layer("bottleneck", DenseLayer(n_out=self.embedding_size,
+                                             activation="identity"), "avgpool")
+        g.add_layer("lossLayer",
+                    CenterLossOutputLayer(n_out=self.num_labels,
+                                          activation="softmax", loss="mcxent",
+                                          alpha=0.1, lambda_=3e-4),
+                    "bottleneck")
+        g.set_outputs("lossLayer")
+        g.set_input_types(InputType.convolutional(h, w, c))
+        return g.build()
+
+    def model_type(self) -> str:
+        return "ComputationGraph"
+
+
+class InceptionResNetV1(ZooModel):
+    """Inception-ResNet v1 embedding net (reference:
+    zoo/model/InceptionResNetV1.java:60-322 — stem, 5x block35, reduction-A,
+    10x block17, reduction-B, 5x block8, avgpool, bottleneck, center-loss).
+    Block counts follow the reference; residual joins are
+    ElementWiseVertex(add) with a post-add activation."""
+
+    input_shape = (160, 160, 3)
+    embedding_size = 128
+
+    def __init__(self, num_labels: int = 1000, **kw):
+        super().__init__(num_labels=num_labels, **kw)
+
+    def _conv_bn(self, g, name, n_out, kernel, stride, pad, input_name,
+                 act="relu"):
+        g.add_layer(name, ConvolutionLayer(n_out=n_out, kernel_size=kernel,
+                                           stride=stride, padding=pad),
+                    input_name)
+        g.add_layer(name + "_bn", BatchNormalization(), name)
+        if act is None:
+            return name + "_bn"
+        g.add_layer(name + "_act", ActivationLayer(activation=act),
+                    name + "_bn")
+        return name + "_act"
+
+    def _block35(self, g, name, input_name, ch=256):
+        b1 = self._conv_bn(g, f"{name}-b1", 32, (1, 1), (1, 1), (0, 0),
+                           input_name)
+        b2 = self._conv_bn(g, f"{name}-b2a", 32, (1, 1), (1, 1), (0, 0),
+                           input_name)
+        b2 = self._conv_bn(g, f"{name}-b2b", 32, (3, 3), (1, 1), (1, 1), b2)
+        b3 = self._conv_bn(g, f"{name}-b3a", 32, (1, 1), (1, 1), (0, 0),
+                           input_name)
+        b3 = self._conv_bn(g, f"{name}-b3b", 32, (3, 3), (1, 1), (1, 1), b3)
+        b3 = self._conv_bn(g, f"{name}-b3c", 32, (3, 3), (1, 1), (1, 1), b3)
+        g.add_vertex(f"{name}-merge", MergeVertex(), b1, b2, b3)
+        up = self._conv_bn(g, f"{name}-up", ch, (1, 1), (1, 1), (0, 0),
+                           f"{name}-merge", act=None)
+        g.add_vertex(f"{name}-add", ElementWiseVertex(op="add"), input_name,
+                     up)
+        g.add_layer(f"{name}", ActivationLayer(activation="relu"),
+                    f"{name}-add")
+        return f"{name}"
+
+    def _block17(self, g, name, input_name, ch=896):
+        b1 = self._conv_bn(g, f"{name}-b1", 128, (1, 1), (1, 1), (0, 0),
+                           input_name)
+        b2 = self._conv_bn(g, f"{name}-b2a", 128, (1, 1), (1, 1), (0, 0),
+                           input_name)
+        b2 = self._conv_bn(g, f"{name}-b2b", 128, (1, 7), (1, 1), (0, 3), b2)
+        b2 = self._conv_bn(g, f"{name}-b2c", 128, (7, 1), (1, 1), (3, 0), b2)
+        g.add_vertex(f"{name}-merge", MergeVertex(), b1, b2)
+        up = self._conv_bn(g, f"{name}-up", ch, (1, 1), (1, 1), (0, 0),
+                           f"{name}-merge", act=None)
+        g.add_vertex(f"{name}-add", ElementWiseVertex(op="add"), input_name,
+                     up)
+        g.add_layer(f"{name}", ActivationLayer(activation="relu"),
+                    f"{name}-add")
+        return f"{name}"
+
+    def _block8(self, g, name, input_name, ch=1792):
+        b1 = self._conv_bn(g, f"{name}-b1", 192, (1, 1), (1, 1), (0, 0),
+                           input_name)
+        b2 = self._conv_bn(g, f"{name}-b2a", 192, (1, 1), (1, 1), (0, 0),
+                           input_name)
+        b2 = self._conv_bn(g, f"{name}-b2b", 192, (1, 3), (1, 1), (0, 1), b2)
+        b2 = self._conv_bn(g, f"{name}-b2c", 192, (3, 1), (1, 1), (1, 0), b2)
+        g.add_vertex(f"{name}-merge", MergeVertex(), b1, b2)
+        up = self._conv_bn(g, f"{name}-up", ch, (1, 1), (1, 1), (0, 0),
+                           f"{name}-merge", act=None)
+        g.add_vertex(f"{name}-add", ElementWiseVertex(op="add"), input_name,
+                     up)
+        g.add_layer(f"{name}", ActivationLayer(activation="relu"),
+                    f"{name}-add")
+        return f"{name}"
+
+    def conf(self):
+        h, w, c = self.input_shape
+        g = (NeuralNetConfiguration.builder()
+             .seed(self.seed).activation("relu").weight_init("relu")
+             .updater(RmsProp(learning_rate=0.1, rms_decay=0.96, epsilon=0.001))
+             .dtype(self.dtype)
+             .graph_builder()
+             .add_inputs("input"))
+        # stem (InceptionResNetV1.java stem: 3x conv, maxpool, 3x conv)
+        x = self._conv_bn(g, "stem1", 32, (3, 3), (2, 2), (0, 0), "input")
+        x = self._conv_bn(g, "stem2", 32, (3, 3), (1, 1), (0, 0), x)
+        x = self._conv_bn(g, "stem3", 64, (3, 3), (1, 1), (1, 1), x)
+        g.add_layer("stem-pool",
+                    SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                     stride=(2, 2)), x)
+        x = self._conv_bn(g, "stem4", 80, (1, 1), (1, 1), (0, 0), "stem-pool")
+        x = self._conv_bn(g, "stem5", 192, (3, 3), (1, 1), (0, 0), x)
+        x = self._conv_bn(g, "stem6", 256, (3, 3), (2, 2), (0, 0), x)
+        for i in range(5):
+            x = self._block35(g, f"block35-{i}", x)
+        # reduction-A
+        ra1 = self._conv_bn(g, "redA-b1", 384, (3, 3), (2, 2), (0, 0), x)
+        ra2 = self._conv_bn(g, "redA-b2a", 192, (1, 1), (1, 1), (0, 0), x)
+        ra2 = self._conv_bn(g, "redA-b2b", 192, (3, 3), (1, 1), (1, 1), ra2)
+        ra2 = self._conv_bn(g, "redA-b2c", 256, (3, 3), (2, 2), (0, 0), ra2)
+        g.add_layer("redA-pool",
+                    SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                     stride=(2, 2)), x)
+        g.add_vertex("redA", MergeVertex(), ra1, ra2, "redA-pool")
+        x = "redA"
+        for i in range(10):
+            x = self._block17(g, f"block17-{i}", x)
+        # reduction-B
+        rb1 = self._conv_bn(g, "redB-b1a", 256, (1, 1), (1, 1), (0, 0), x)
+        rb1 = self._conv_bn(g, "redB-b1b", 384, (3, 3), (2, 2), (0, 0), rb1)
+        rb2 = self._conv_bn(g, "redB-b2a", 256, (1, 1), (1, 1), (0, 0), x)
+        rb2 = self._conv_bn(g, "redB-b2b", 256, (3, 3), (2, 2), (0, 0), rb2)
+        rb3 = self._conv_bn(g, "redB-b3a", 256, (1, 1), (1, 1), (0, 0), x)
+        rb3 = self._conv_bn(g, "redB-b3b", 256, (3, 3), (1, 1), (1, 1), rb3)
+        rb3 = self._conv_bn(g, "redB-b3c", 256, (3, 3), (2, 2), (0, 0), rb3)
+        g.add_layer("redB-pool",
+                    SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                     stride=(2, 2)), x)
+        g.add_vertex("redB", MergeVertex(), rb1, rb2, rb3, "redB-pool")
+        x = "redB"
+        for i in range(5):
+            x = self._block8(g, f"block8-{i}", x)
+        g.add_layer("avgpool", GlobalPoolingLayer(pooling_type="avg"), x)
+        g.add_layer("bottleneck", DenseLayer(n_out=self.embedding_size,
+                                             activation="identity"),
+                    "avgpool")
+        g.add_layer("lossLayer",
+                    CenterLossOutputLayer(n_out=self.num_labels,
+                                          activation="softmax", loss="mcxent",
+                                          alpha=0.1, lambda_=3e-4),
+                    "bottleneck")
+        g.set_outputs("lossLayer")
+        g.set_input_types(InputType.convolutional(h, w, c))
+        return g.build()
+
+    def model_type(self) -> str:
+        return "ComputationGraph"
+
+
+class TextGenerationLSTM(ZooModel):
+    """Char-level text-generation LSTM (reference:
+    zoo/model/TextGenerationLSTM.java:77-94): GravesLSTM(256) x2 +
+    RnnOutputLayer, truncated BPTT 50/50. On TPU the LSTM is a lax.scan whose
+    per-step gate matmul hits the MXU."""
+
+    def __init__(self, num_labels: int = 77, max_length: int = 40, **kw):
+        super().__init__(num_labels=num_labels, **kw)
+        self.max_length = max_length
+        self.input_shape = (max_length, num_labels)
+
+    def conf(self):
+        return (NeuralNetConfiguration.builder()
+                .seed(self.seed).weight_init("xavier")
+                .updater(RmsProp(learning_rate=0.01)).l2(0.001)
+                .dtype(self.dtype)
+                .list(
+                    GravesLSTM(n_out=256, activation="tanh"),
+                    GravesLSTM(n_out=256, activation="tanh"),
+                    RnnOutputLayer(n_out=self.num_labels,
+                                   activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.recurrent(self.num_labels))
+                .t_bptt_lengths(50, 50)
+                .build())
+
+
+def zoo_models() -> dict:
+    """Name -> ZooModel class registry (reference: zoo/ModelSelector.java)."""
+    return {
+        "alexnet": AlexNet,
+        "facenetnn4small2": FaceNetNN4Small2,
+        "googlenet": GoogLeNet,
+        "inceptionresnetv1": InceptionResNetV1,
+        "lenet": LeNet,
+        "resnet50": ResNet50,
+        "simplecnn": SimpleCNN,
+        "textgenlstm": TextGenerationLSTM,
+        "vgg16": VGG16,
+        "vgg19": VGG19,
+    }

@@ -67,7 +67,7 @@ class GraphVertex:
     def init_params(self, rng, dtype=jnp.float32) -> dict:
         return {}
 
-    def init_state(self) -> dict:
+    def init_state(self, dtype=jnp.float32) -> dict:
         return {}
 
     def has_params(self) -> bool:
@@ -111,8 +111,8 @@ class LayerVertex(GraphVertex):
     def init_params(self, rng, dtype=jnp.float32):
         return self.layer.init_params(rng, dtype)
 
-    def init_state(self):
-        return self.layer.init_state()
+    def init_state(self, dtype=jnp.float32):
+        return self.layer.init_state(dtype)
 
     def regularization(self, params):
         return self.layer.regularization(params)

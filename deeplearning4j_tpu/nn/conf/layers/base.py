@@ -67,7 +67,7 @@ class Layer:
     def init_params(self, rng, dtype=jnp.float32) -> dict:
         return {}
 
-    def init_state(self) -> dict:
+    def init_state(self, dtype=jnp.float32) -> dict:
         return {}
 
     def has_params(self) -> bool:

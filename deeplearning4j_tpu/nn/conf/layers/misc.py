@@ -39,8 +39,8 @@ class FrozenLayer(Layer):
     def init_params(self, rng, dtype=jnp.float32):
         return self.inner.init_params(rng, dtype)
 
-    def init_state(self):
-        return self.inner.init_state()
+    def init_state(self, dtype=jnp.float32):
+        return self.inner.init_state(dtype)
 
     def feed_forward_mask(self, mask, current_mask_state="active"):
         return self.inner.feed_forward_mask(mask, current_mask_state)
@@ -64,8 +64,8 @@ class CenterLossOutputLayer(OutputLayer):
     alpha: float = 0.05
     lambda_: float = 2e-4
 
-    def init_state(self):
-        return {"centers": jnp.zeros((self.n_out, self.n_in))}
+    def init_state(self, dtype=jnp.float32):
+        return {"centers": jnp.zeros((self.n_out, self.n_in), dtype)}
 
     def compute_loss_per_example(self, params, x, labels, weights=None, state=None):
         base = super().compute_loss_per_example(params, x, labels, weights)

@@ -62,9 +62,9 @@ class BatchNormalization(BaseLayer):
         return {"gamma": jnp.full((self.n_out,), self.gamma_init, dtype),
                 "beta": jnp.full((self.n_out,), self.beta_init, dtype)}
 
-    def init_state(self):
-        return {"mean": jnp.zeros((self.n_out,)),
-                "var": jnp.ones((self.n_out,))}
+    def init_state(self, dtype=jnp.float32):
+        return {"mean": jnp.zeros((self.n_out,), dtype),
+                "var": jnp.ones((self.n_out,), dtype)}
 
     def forward(self, params, state, x, *, mask=None, train=False, rng=None):
         x = self.apply_input_dropout(x, train=train, rng=rng)

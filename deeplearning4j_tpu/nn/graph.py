@@ -61,7 +61,7 @@ class ComputationGraph:
                            for i, name in enumerate(order)}
         else:
             self.params = params
-        self.state = {name: self.conf.vertices[name].init_state()
+        self.state = {name: self.conf.vertices[name].init_state(dtype)
                       for name in order}
         self.updater_state = self.conf.updater.init(self.params)
         return self

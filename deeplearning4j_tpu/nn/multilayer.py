@@ -67,7 +67,7 @@ class MultiLayerNetwork:
                            for i, l in enumerate(self.layers)}
         else:
             self.params = params
-        self.state = {str(i): l.init_state() for i, l in enumerate(self.layers)}
+        self.state = {str(i): l.init_state(dtype) for i, l in enumerate(self.layers)}
         self.updater_state = self.conf.updater.init(self._trainable(self.params))
         return self
 

@@ -1,0 +1,426 @@
+"""Transfer learning: rebuild a trained net with frozen layers, new heads.
+
+Reference: nn/transferlearning/TransferLearning.java:35 (Builder :62-275,
+GraphBuilder :444-720), FineTuneConfiguration.java, TransferLearningHelper.java.
+
+TPU-native mechanics: freezing wraps a layer config in ``FrozenLayer`` whose
+forward stop-gradients its params — XLA then prunes the dead backward graph,
+so frozen layers cost zero backward FLOPs (the reference instead zeroes
+updates after computing them). Parameter transfer is pytree copying; replaced
+layers re-initialise from the configured scheme.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, fields as dc_fields
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.builders import (
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.conf.graph_conf import (
+    ComputationGraphConfiguration,
+    GraphVertex,
+    LayerVertex,
+    topological_sort,
+)
+from deeplearning4j_tpu.nn.conf.layers.misc import FrozenLayer
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.utils.serde import register_serializable
+
+
+@register_serializable
+@dataclass
+class FineTuneConfiguration:
+    """Global hyperparameter overrides applied to every *unfrozen* layer and
+    to the network config (reference: FineTuneConfiguration.java — only
+    explicitly-set values override)."""
+
+    seed: Optional[int] = None
+    updater: Optional[object] = None
+    activation: Optional[str] = None
+    weight_init: Optional[str] = None
+    bias_init: Optional[float] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    l1_bias: Optional[float] = None
+    l2_bias: Optional[float] = None
+    dropout: Optional[float] = None
+    learning_rate: Optional[float] = None
+
+    def apply_to_layer(self, layer) -> None:
+        if isinstance(layer, FrozenLayer):
+            return  # frozen layers keep their config (reference parity)
+        for f in ("activation", "weight_init", "bias_init", "l1", "l2",
+                  "l1_bias", "l2_bias", "dropout", "learning_rate"):
+            v = getattr(self, f)
+            if v is not None and hasattr(layer, f):
+                setattr(layer, f, v)
+
+    def apply_to_conf(self, conf) -> None:
+        if self.seed is not None:
+            conf.seed = self.seed
+        if self.updater is not None:
+            conf.updater = copy.deepcopy(self.updater)
+        elif self.learning_rate is not None and hasattr(conf.updater,
+                                                        "learning_rate"):
+            conf.updater.learning_rate = self.learning_rate
+
+
+def _freeze(layer):
+    return layer if isinstance(layer, FrozenLayer) else FrozenLayer(inner=layer)
+
+
+class TransferLearning:
+    """Namespace matching the reference's TransferLearning.Builder /
+    TransferLearning.GraphBuilder entry points."""
+
+    class Builder:
+        """reference: TransferLearning.java:62-430 (MultiLayerNetwork)."""
+
+        def __init__(self, orig: MultiLayerNetwork):
+            self._orig = orig
+            self._conf = copy.deepcopy(orig.conf)
+            self._layers = list(self._conf.layers)
+            # param source per kept layer: orig index, or None -> re-init
+            self._sources = list(range(len(self._layers)))
+            self._ftc: Optional[FineTuneConfiguration] = None
+            self._frozen_till = -1
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+            self._ftc = ftc
+            return self
+
+        def set_feature_extractor(self, layer_num: int):
+            """Freeze layers [0, layer_num] (reference :87-99)."""
+            self._frozen_till = layer_num
+            return self
+
+        def nout_replace(self, layer_num: int, n_out: int,
+                         weight_init: Optional[str] = None, dist=None,
+                         scheme_next: Optional[str] = None, dist_next=None):
+            """Change nOut of layer_num; re-init it and the nIn side of the
+            next parameterised layer (reference :101-198)."""
+            layer = self._layers[layer_num]
+            inner = layer.inner if isinstance(layer, FrozenLayer) else layer
+            inner.n_out = n_out
+            if weight_init is not None:
+                inner.weight_init = weight_init
+            if dist is not None:
+                inner.weight_init = "distribution"
+                inner.dist = dist
+            self._sources[layer_num] = None
+            # downstream: first layer with params needs new nIn/weights
+            for j in range(layer_num + 1, len(self._layers)):
+                nxt = self._layers[j]
+                ninner = nxt.inner if isinstance(nxt, FrozenLayer) else nxt
+                if hasattr(ninner, "n_in"):
+                    ninner.n_in = 0  # re-infer at build
+                if ninner.param_order():
+                    if scheme_next is not None:
+                        ninner.weight_init = scheme_next
+                    if dist_next is not None:
+                        ninner.weight_init = "distribution"
+                        ninner.dist = dist_next
+                    self._sources[j] = None
+                    break
+            return self
+
+        def remove_output_layer(self):
+            return self.remove_layers_from_output(1)
+
+        def remove_layers_from_output(self, n: int):
+            """Drop the last n layers (reference :199-226)."""
+            if n <= 0:
+                raise ValueError(f"remove_layers_from_output requires n >= 1, "
+                                 f"got {n}")
+            self._layers = self._layers[:-n]
+            self._sources = self._sources[:-n]
+            return self
+
+        def add_layer(self, layer):
+            """Append a new layer (reference :228-262)."""
+            self._layers.append(layer)
+            self._sources.append(None)
+            return self
+
+        def set_input_pre_processor(self, layer_idx: int, p):
+            self._conf.preprocessors[layer_idx] = p
+            return self
+
+        def build(self) -> MultiLayerNetwork:
+            layers = [copy.deepcopy(l) for l in self._layers]
+            if self._frozen_till >= 0:
+                layers = [(_freeze(l) if i <= self._frozen_till else l)
+                          for i, l in enumerate(layers)]
+            g = NeuralNetConfiguration(seed=self._conf.seed,
+                                       updater=copy.deepcopy(self._conf.updater),
+                                       dtype=self._conf.dtype)
+            if self._ftc is not None:
+                self._ftc.apply_to_conf(g)
+                for l in layers:
+                    self._ftc.apply_to_layer(l)
+            builder = NeuralNetConfiguration.builder()
+            builder._c = g
+            lb = builder.list(*layers)
+            if self._conf.input_type is not None:
+                lb.set_input_type(self._conf.input_type)
+            for i, p in self._conf.preprocessors.items():
+                if i < len(layers):
+                    lb.input_pre_processor(i, p)
+            if self._conf.backprop_type == "tbptt":
+                lb.t_bptt_lengths(self._conf.tbptt_fwd_length,
+                                  self._conf.tbptt_back_length)
+            new_conf = lb.build()
+            net = MultiLayerNetwork(new_conf).init()
+            # transfer params for kept layers
+            for i, src in enumerate(self._sources):
+                if src is not None:
+                    net.params[str(i)] = jax.tree_util.tree_map(
+                        lambda a: a, self._orig.params[str(src)])
+                    if str(src) in self._orig.state:
+                        net.state[str(i)] = jax.tree_util.tree_map(
+                            lambda a: a, self._orig.state[str(src)])
+            net.updater_state = new_conf.updater.init(net.params)
+            return net
+
+    class GraphBuilder:
+        """reference: TransferLearning.java:444-720 (ComputationGraph)."""
+
+        def __init__(self, orig: ComputationGraph):
+            self._orig = orig
+            self._conf = copy.deepcopy(orig.conf)
+            self._copy_from = {n: n for n in self._conf.vertices}
+            self._ftc: Optional[FineTuneConfiguration] = None
+            self._frozen: set = set()
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+            self._ftc = ftc
+            return self
+
+        def set_feature_extractor(self, *vertex_names):
+            """Freeze the named vertices and all their ancestors
+            (reference :480-497)."""
+            conf = self._conf
+            parents: dict = conf.vertex_inputs
+            stack = list(vertex_names)
+            while stack:
+                n = stack.pop()
+                if n in self._frozen or n in conf.network_inputs:
+                    continue
+                self._frozen.add(n)
+                stack.extend(p for p in parents.get(n, ()))
+            return self
+
+        def nout_replace(self, vertex_name: str, n_out: int,
+                         weight_init: Optional[str] = None, dist=None):
+            """reference :499-610 (+ downstream nIn re-inference at build)."""
+            v = self._conf.vertices[vertex_name]
+            if not isinstance(v, LayerVertex):
+                raise ValueError(f"'{vertex_name}' is not a layer vertex")
+            v.layer.n_out = n_out
+            if weight_init is not None:
+                v.layer.weight_init = weight_init
+            if dist is not None:
+                v.layer.weight_init = "distribution"
+                v.layer.dist = dist
+            self._copy_from[vertex_name] = None
+            # Downstream width change propagates through parameterless
+            # vertices (ElementWise/Merge/Activation...) until it reaches
+            # parameterised layers, which re-infer nIn and re-init (the MLN
+            # builder's scan-to-next-parameterised-layer, generalised to DAGs)
+            consumers: dict = {}
+            for name, ins in self._conf.vertex_inputs.items():
+                for i in ins:
+                    consumers.setdefault(i, []).append(name)
+            stack = list(consumers.get(vertex_name, ()))
+            seen = set()
+            while stack:
+                name = stack.pop()
+                if name in seen:
+                    continue
+                seen.add(name)
+                c = self._conf.vertices[name]
+                if isinstance(c, LayerVertex):
+                    if hasattr(c.layer, "n_in"):
+                        c.layer.n_in = 0
+                    if c.layer.param_order():
+                        self._copy_from[name] = None
+                        continue  # parameterised layer absorbs the change
+                stack.extend(consumers.get(name, ()))
+            return self
+
+        def remove_vertex_and_connections(self, name: str):
+            """reference :623-634"""
+            self._conf.vertices.pop(name)
+            self._conf.vertex_inputs.pop(name)
+            self._copy_from.pop(name, None)
+            self._frozen.discard(name)
+            for n, ins in list(self._conf.vertex_inputs.items()):
+                if name in ins:
+                    self.remove_vertex_and_connections(n)
+            self._conf.network_outputs = [o for o in
+                                          self._conf.network_outputs
+                                          if o != name]
+            return self
+
+        def remove_vertex_keep_connections(self, name: str):
+            """Remove a vertex, rewiring its consumers to its input
+            (reference :612-621; valid for single-input vertices)."""
+            ins = self._conf.vertex_inputs.pop(name)
+            self._conf.vertices.pop(name)
+            self._copy_from.pop(name, None)
+            self._frozen.discard(name)
+            if len(ins) != 1:
+                raise ValueError("remove_vertex_keep_connections requires a "
+                                 "single-input vertex")
+            src = ins[0]
+            for n, vins in self._conf.vertex_inputs.items():
+                self._conf.vertex_inputs[n] = [src if i == name else i
+                                               for i in vins]
+            self._conf.network_outputs = [src if o == name else o
+                                          for o in self._conf.network_outputs]
+            return self
+
+        def add_layer(self, name: str, layer, *inputs, preprocessor=None):
+            return self.add_vertex(
+                name, LayerVertex(layer=layer, preprocessor=preprocessor),
+                *inputs)
+
+        def add_vertex(self, name: str, vertex: GraphVertex, *inputs):
+            if name in self._conf.vertices:
+                raise ValueError(f"Duplicate vertex '{name}'")
+            vertex.name = name
+            self._conf.vertices[name] = vertex
+            self._conf.vertex_inputs[name] = list(inputs)
+            self._copy_from[name] = None
+            return self
+
+        def set_outputs(self, *names):
+            self._conf.network_outputs = list(names)
+            return self
+
+        def build(self) -> ComputationGraph:
+            conf = self._conf
+            for n in self._frozen:
+                v = conf.vertices[n]
+                if isinstance(v, LayerVertex):
+                    v.layer = _freeze(v.layer)
+            if self._ftc is not None:
+                self._ftc.apply_to_conf(conf)
+                for n, v in conf.vertices.items():
+                    if isinstance(v, LayerVertex) and n not in self._frozen:
+                        self._ftc.apply_to_layer(v.layer)
+            # rebuild via GraphBuilder for topo-order + shape re-inference
+            g = NeuralNetConfiguration(seed=conf.seed,
+                                       updater=copy.deepcopy(conf.updater),
+                                       dtype=conf.dtype)
+            nb = NeuralNetConfiguration.builder()
+            nb._c = g
+            gb = nb.graph_builder()
+            gb.add_inputs(*conf.network_inputs)
+            if conf.input_types is not None:
+                gb.set_input_types(*conf.input_types)
+            order = topological_sort(conf.vertex_inputs, conf.network_inputs)
+            for n in order:
+                gb.add_vertex(n, conf.vertices[n], *conf.vertex_inputs[n])
+            gb.set_outputs(*conf.network_outputs)
+            if conf.backprop_type == "tbptt":
+                gb.t_bptt_lengths(conf.tbptt_fwd_length,
+                                  conf.tbptt_back_length)
+            new_conf = gb.build()
+            net = ComputationGraph(new_conf).init()
+            for n, src in self._copy_from.items():
+                if src is not None and n in net.params:
+                    net.params[n] = jax.tree_util.tree_map(
+                        lambda a: a, self._orig.params[src])
+                    if src in self._orig.state:
+                        net.state[n] = jax.tree_util.tree_map(
+                            lambda a: a, self._orig.state[src])
+            net.updater_state = new_conf.updater.init(net.params)
+            return net
+
+
+class TransferLearningHelper:
+    """Featurization helper (reference: TransferLearningHelper.java): run the
+    frozen part ONCE per dataset, then train only the unfrozen tail on the
+    cached features — the frozen forward never re-executes."""
+
+    def __init__(self, net):
+        self.net = net
+        if isinstance(net, MultiLayerNetwork):
+            self._init_mln()
+        else:
+            raise ValueError("TransferLearningHelper supports "
+                             "MultiLayerNetwork (use featurize + a sub-graph "
+                             "manually for ComputationGraph)")
+
+    def _init_mln(self):
+        layers = self.net.conf.layers
+        k = 0
+        while k < len(layers) and isinstance(layers[k], FrozenLayer):
+            k += 1
+        if k == 0:
+            raise ValueError("Network has no frozen layers")
+        self._boundary = k
+        # sub-network over the unfrozen tail, sharing conf hyperparams
+        tail = [copy.deepcopy(l) for l in layers[k:]]
+        g = NeuralNetConfiguration(seed=self.net.conf.seed,
+                                   updater=copy.deepcopy(self.net.conf.updater),
+                                   dtype=self.net.conf.dtype)
+        nb = NeuralNetConfiguration.builder()
+        nb._c = g
+        # tail layers already carry their resolved nIn values, so the
+        # sub-config needs no input type for re-inference
+        lb = nb.list(*tail)
+        sub_conf = lb.build()
+        # shift preprocessors into the sub-network
+        sub_conf.preprocessors = {
+            i - k: p for i, p in self.net.conf.preprocessors.items()
+            if i >= k}
+        self.sub_net = MultiLayerNetwork(sub_conf).init(params={
+            str(i - k): self.net.params[str(i)]
+            for i in range(k, len(layers))})
+        self.sub_net.state = {str(i - k): self.net.state.get(str(i), {})
+                              for i in range(k, len(layers))}
+        self.sub_net.updater_state = sub_conf.updater.init(self.sub_net.params)
+
+    def featurize(self, ds):
+        """DataSet -> DataSet with features = activations at the frozen
+        boundary (reference: TransferLearningHelper.featurize)."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        x = jnp.asarray(ds.features)
+        mask = (jnp.asarray(ds.features_mask)
+                if ds.features_mask is not None else None)
+        feats, _, _, out_mask = self.net._forward(
+            self.net.params, self.net.state, x, mask, train=False, rng=None,
+            upto=self._boundary)
+        import numpy as np
+        return DataSet(np.asarray(feats), ds.labels,
+                       None if out_mask is None else np.asarray(out_mask),
+                       ds.labels_mask)
+
+    def fit_featurized(self, ds, epochs: int = 1):
+        """Train the unfrozen tail on featurized data, then write params back
+        into the full network."""
+        self.sub_net.fit(ds, epochs=epochs)
+        k = self._boundary
+        for i in range(k, len(self.net.conf.layers)):
+            self.net.params[str(i)] = self.sub_net.params[str(i - k)]
+            sub_state = self.sub_net.state.get(str(i - k), {})
+            if sub_state:
+                self.net.state[str(i)] = sub_state
+        return self
+
+    def output_featurized(self, features):
+        return self.sub_net.output(features)
+
+    def unfrozen_mln(self) -> MultiLayerNetwork:
+        return self.sub_net

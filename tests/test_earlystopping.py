@@ -1,0 +1,149 @@
+"""Early stopping tests (ports the intent of
+deeplearning4j-core/src/test/.../earlystopping/TestEarlyStopping.java)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.earlystopping import (
+    DataSetLossCalculator,
+    EarlyStoppingConfiguration,
+    EarlyStoppingTrainer,
+    InMemoryModelSaver,
+    InvalidScoreIterationTerminationCondition,
+    LocalFileModelSaver,
+    MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+)
+from deeplearning4j_tpu.earlystopping.config import TerminationReason
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Adam, Sgd
+
+
+def _net(lr=0.01, updater=None):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(12345)
+            .updater(updater or Adam(learning_rate=lr))
+            .list(DenseLayer(n_out=16, activation="relu"),
+                  OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _iris_like_iterator(n=60, batch=20, seed=0):
+    rs = np.random.RandomState(seed)
+    labels = rs.randint(0, 3, n)
+    x = (rs.randn(n, 4) + labels[:, None] * 2.0).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[labels]
+    ds = DataSet(x, y)
+    return ListDataSetIterator(list(ds.batch_by(batch)), batch_size=batch)
+
+
+class TestEarlyStopping:
+    def test_max_epochs_termination(self):
+        net = _net()
+        it = _iris_like_iterator()
+        cfg = EarlyStoppingConfiguration(
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(5)],
+            score_calculator=DataSetLossCalculator(_iris_like_iterator(seed=1)),
+            model_saver=InMemoryModelSaver())
+        result = EarlyStoppingTrainer(cfg, net, it).fit()
+        assert result.termination_reason == \
+            TerminationReason.EPOCH_TERMINATION_CONDITION
+        assert result.total_epochs == 5
+        assert result.best_model is not None
+        assert len(result.score_vs_epoch) == 5
+        # best model's score matches the recorded best
+        best = result.best_model
+        calc = DataSetLossCalculator(_iris_like_iterator(seed=1))
+        assert calc.calculate_score(best) == pytest.approx(
+            result.best_model_score, rel=1e-5)
+
+    def test_score_improvement_termination(self):
+        """Training with LR=0 can't improve -> stops after patience runs out."""
+        net = _net(updater=Sgd(learning_rate=0.0))
+        it = _iris_like_iterator()
+        cfg = EarlyStoppingConfiguration(
+            epoch_termination_conditions=[
+                ScoreImprovementEpochTerminationCondition(2),
+                MaxEpochsTerminationCondition(50)],
+            score_calculator=DataSetLossCalculator(_iris_like_iterator(seed=1)))
+        result = EarlyStoppingTrainer(cfg, net, it).fit()
+        assert result.termination_reason == \
+            TerminationReason.EPOCH_TERMINATION_CONDITION
+        assert "ScoreImprovement" in result.termination_details
+        assert result.total_epochs <= 5
+
+    def test_max_score_iteration_termination(self):
+        """Huge LR diverges -> MaxScoreIterationTerminationCondition fires."""
+        net = _net(updater=Sgd(learning_rate=1e4))
+        it = _iris_like_iterator()
+        cfg = EarlyStoppingConfiguration(
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(100)],
+            iteration_termination_conditions=[
+                MaxScoreIterationTerminationCondition(50.0),
+                InvalidScoreIterationTerminationCondition()],
+            score_calculator=DataSetLossCalculator(_iris_like_iterator(seed=1)))
+        result = EarlyStoppingTrainer(cfg, net, it).fit()
+        assert result.termination_reason == \
+            TerminationReason.ITERATION_TERMINATION_CONDITION
+
+    def test_max_time_termination(self):
+        net = _net()
+        it = _iris_like_iterator()
+        cfg = EarlyStoppingConfiguration(
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(10000)],
+            iteration_termination_conditions=[
+                MaxTimeIterationTerminationCondition(0.0)],
+            score_calculator=DataSetLossCalculator(_iris_like_iterator(seed=1)))
+        result = EarlyStoppingTrainer(cfg, net, it).fit()
+        assert result.termination_reason == \
+            TerminationReason.ITERATION_TERMINATION_CONDITION
+        assert "MaxTime" in result.termination_details
+
+    def test_local_file_saver_roundtrip(self, tmp_path):
+        net = _net()
+        it = _iris_like_iterator()
+        saver = LocalFileModelSaver(str(tmp_path))
+        cfg = EarlyStoppingConfiguration(
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(3)],
+            score_calculator=DataSetLossCalculator(_iris_like_iterator(seed=1)),
+            model_saver=saver, save_last_model=True)
+        result = EarlyStoppingTrainer(cfg, net, it).fit()
+        assert (tmp_path / "bestModel.bin").exists()
+        assert (tmp_path / "latestModel.bin").exists()
+        best = saver.get_best_model()
+        x = np.random.RandomState(5).randn(4, 4).astype(np.float32)
+        assert np.asarray(best.output(x)).shape == (4, 3)
+        assert result.best_model_epoch >= 0
+        assert result.best_model_epoch in result.score_vs_epoch
+
+    def test_early_stopping_graph(self):
+        """Same loop drives a ComputationGraph (reference:
+        EarlyStoppingGraphTrainer)."""
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        conf = (NeuralNetConfiguration.builder()
+                .seed(1).updater(Adam(learning_rate=0.01))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("d", DenseLayer(n_out=16, activation="relu"), "in")
+                .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                              loss="mcxent"), "d")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(4))
+                .build())
+        net = ComputationGraph(conf).init()
+        cfg = EarlyStoppingConfiguration(
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(3)],
+            score_calculator=DataSetLossCalculator(_iris_like_iterator(seed=1)))
+        result = EarlyStoppingTrainer(cfg, net, _iris_like_iterator()).fit()
+        assert result.total_epochs == 3
+        assert result.best_model is not None

@@ -1,0 +1,232 @@
+"""Transfer learning tests (ports the intent of
+nn/transferlearning/TransferLearningMLNTest.java / CompGraphTest.java /
+TransferLearningHelperTest.java)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graph_conf import MergeVertex
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.layers.misc import FrozenLayer
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.transferlearning import (
+    FineTuneConfiguration,
+    TransferLearning,
+    TransferLearningHelper,
+)
+from deeplearning4j_tpu.nn.updater import Adam, Sgd
+
+
+def _mln(n_in=4, n_out=3, seed=7):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).updater(Adam(learning_rate=0.01))
+            .list(DenseLayer(n_out=8, activation="tanh"),
+                  DenseLayer(n_out=6, activation="tanh"),
+                  OutputLayer(n_out=n_out, activation="softmax",
+                              loss="mcxent"))
+            .set_input_type(InputType.feed_forward(n_in))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=24, n_in=4, n_classes=3, seed=0):
+    rs = np.random.RandomState(seed)
+    labels = rs.randint(0, n_classes, n)
+    x = (rs.randn(n, n_in) + labels[:, None]).astype(np.float32)
+    return DataSet(x, np.eye(n_classes, dtype=np.float32)[labels])
+
+
+class TestTransferLearningMLN:
+    def test_feature_extractor_freezes_layers(self):
+        net = _mln()
+        ds = _data()
+        net.fit(ds, epochs=3)
+        new_net = (TransferLearning.Builder(net)
+                   .set_feature_extractor(1)
+                   .build())
+        assert isinstance(new_net.conf.layers[0], FrozenLayer)
+        assert isinstance(new_net.conf.layers[1], FrozenLayer)
+        assert not isinstance(new_net.conf.layers[2], FrozenLayer)
+        p0_before = np.asarray(new_net.params["0"]["W"]).copy()
+        p2_before = np.asarray(new_net.params["2"]["W"]).copy()
+        new_net.fit(ds, epochs=5)
+        assert np.allclose(np.asarray(new_net.params["0"]["W"]), p0_before)
+        assert not np.allclose(np.asarray(new_net.params["2"]["W"]),
+                               p2_before)
+
+    def test_frozen_params_copied_from_original(self):
+        net = _mln()
+        ds = _data()
+        net.fit(ds, epochs=2)
+        new_net = (TransferLearning.Builder(net)
+                   .set_feature_extractor(0).build())
+        for i in ("0", "1", "2"):
+            for k in net.params[i]:
+                assert np.allclose(np.asarray(net.params[i][k]),
+                                   np.asarray(new_net.params[i][k]))
+
+    def test_nout_replace_reinits_this_and_next(self):
+        net = _mln()
+        new_net = (TransferLearning.Builder(net)
+                   .nout_replace(1, 12, weight_init="xavier")
+                   .build())
+        assert new_net.params["1"]["W"].shape == (8, 12)
+        assert new_net.params["2"]["W"].shape == (12, 3)
+        # layer 0 copied
+        assert np.allclose(np.asarray(net.params["0"]["W"]),
+                           np.asarray(new_net.params["0"]["W"]))
+
+    def test_remove_and_add_output_layer(self):
+        net = _mln()
+        new_net = (TransferLearning.Builder(net)
+                   .set_feature_extractor(1)
+                   .remove_output_layer()
+                   .add_layer(DenseLayer(n_out=5, activation="relu"))
+                   .add_layer(OutputLayer(n_out=7, activation="softmax",
+                                          loss="mcxent"))
+                   .build())
+        assert len(new_net.conf.layers) == 4
+        x = _data().features
+        out = np.asarray(new_net.output(x))
+        assert out.shape == (24, 7)
+        new_net.fit(_data(n_classes=7), epochs=2)
+
+    def test_fine_tune_configuration_overrides(self):
+        net = _mln()
+        ftc = FineTuneConfiguration(updater=Sgd(learning_rate=0.5),
+                                    l2=0.01, seed=99)
+        new_net = (TransferLearning.Builder(net)
+                   .fine_tune_configuration(ftc)
+                   .build())
+        assert type(new_net.conf.updater).__name__ == "Sgd"
+        assert new_net.conf.updater.learning_rate == 0.5
+        assert new_net.conf.seed == 99
+        assert new_net.conf.layers[1].l2 == 0.01
+
+    def test_transfer_net_trains(self):
+        net = _mln()
+        ds = _data()
+        net.fit(ds, epochs=3)
+        new_net = (TransferLearning.Builder(net)
+                   .set_feature_extractor(0)
+                   .nout_replace(2, 3, weight_init="xavier")
+                   .build())
+        s0 = new_net.score(ds)
+        new_net.fit(ds, epochs=10)
+        assert new_net.score(ds) < s0
+
+
+class TestTransferLearningHelper:
+    def test_featurize_and_fit(self):
+        net = _mln()
+        ds = _data()
+        net.fit(ds, epochs=2)
+        frozen = (TransferLearning.Builder(net)
+                  .set_feature_extractor(1).build())
+        helper = TransferLearningHelper(frozen)
+        fds = helper.featurize(ds)
+        assert fds.features.shape == (24, 6)  # boundary activations
+        s0 = helper.unfrozen_mln().score(fds)
+        helper.fit_featurized(fds, epochs=10)
+        assert helper.unfrozen_mln().score(fds) < s0
+        # featurized training == full-net equivalent output
+        out_full = np.asarray(frozen.output(ds.features))
+        out_sub = np.asarray(helper.output_featurized(fds.features))
+        assert np.allclose(out_full, out_sub, atol=1e-5)
+
+
+class TestTransferLearningGraph:
+    def _graph(self):
+        conf = (NeuralNetConfiguration.builder()
+                .seed(3).updater(Adam(learning_rate=0.01))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("d1", DenseLayer(n_out=8, activation="tanh"), "in")
+                .add_layer("d2", DenseLayer(n_out=6, activation="tanh"), "d1")
+                .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                              loss="mcxent"), "d2")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(4))
+                .build())
+        return ComputationGraph(conf).init()
+
+    def test_freeze_ancestors(self):
+        g = self._graph()
+        ds = _data()
+        g.fit(ds, epochs=2)
+        new_g = (TransferLearning.GraphBuilder(g)
+                 .set_feature_extractor("d2")
+                 .build())
+        assert isinstance(new_g.conf.vertices["d1"].layer, FrozenLayer)
+        assert isinstance(new_g.conf.vertices["d2"].layer, FrozenLayer)
+        assert not isinstance(new_g.conf.vertices["out"].layer, FrozenLayer)
+        d1 = np.asarray(new_g.params["d1"]["W"]).copy()
+        new_g.fit(ds, epochs=4)
+        assert np.allclose(np.asarray(new_g.params["d1"]["W"]), d1)
+
+    def test_replace_head(self):
+        g = self._graph()
+        new_g = (TransferLearning.GraphBuilder(g)
+                 .set_feature_extractor("d2")
+                 .remove_vertex_and_connections("out")
+                 .add_layer("newout", OutputLayer(n_out=5,
+                                                  activation="softmax",
+                                                  loss="mcxent"), "d2")
+                 .set_outputs("newout")
+                 .build())
+        out = np.asarray(new_g.output(_data().features))
+        assert out.shape == (24, 5)
+        new_g.fit(_data(n_classes=5), epochs=2)
+
+    def test_nout_replace_graph(self):
+        g = self._graph()
+        new_g = (TransferLearning.GraphBuilder(g)
+                 .nout_replace("d2", 10, weight_init="xavier")
+                 .build())
+        assert new_g.params["d2"]["W"].shape == (8, 10)
+        assert new_g.params["out"]["W"].shape == (10, 3)
+
+    def test_nout_replace_propagates_through_parameterless_vertices(self):
+        """Width change must flow through ElementWise/Activation vertices to
+        the next parameterised layer (the DAG analogue of the MLN builder's
+        scan-to-next-parameterised-layer)."""
+        from deeplearning4j_tpu.nn.conf.graph_conf import ElementWiseVertex
+        from deeplearning4j_tpu.nn.conf.layers.core import ActivationLayer
+
+        conf = (NeuralNetConfiguration.builder()
+                .seed(5).updater(Adam(learning_rate=0.01))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("d1", DenseLayer(n_out=6, activation="tanh"), "in")
+                .add_layer("d2", DenseLayer(n_out=6, activation="identity"),
+                           "d1")
+                .add_vertex("res", ElementWiseVertex(op="add"), "d1", "d2")
+                .add_layer("act", ActivationLayer(activation="relu"), "res")
+                .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                              loss="mcxent"), "act")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(4))
+                .build())
+        g = ComputationGraph(conf).init()
+        new_g = (TransferLearning.GraphBuilder(g)
+                 .nout_replace("d1", 9, weight_init="xavier")
+                 .nout_replace("d2", 9, weight_init="xavier")
+                 .build())
+        assert new_g.params["out"]["W"].shape == (9, 3)
+        out = np.asarray(new_g.output(_data().features))
+        assert out.shape == (24, 3)
+
+    def test_remove_frozen_vertex_then_build(self):
+        g = self._graph()
+        new_g = (TransferLearning.GraphBuilder(g)
+                 .set_feature_extractor("d2")
+                 .remove_vertex_and_connections("out")
+                 .add_layer("newout", OutputLayer(n_out=2,
+                                                  activation="softmax",
+                                                  loss="mcxent"), "d2")
+                 .set_outputs("newout")
+                 .build())
+        assert "newout" in new_g.conf.vertices
