@@ -88,9 +88,31 @@ def bench_lstm(batch: int = 64, seq: int = 50, vocab: int = 77,
     return batch * seq * steps / (time.perf_counter() - t0)
 
 
+def bench_word2vec(n_sentences: int = 2000, epochs: int = 1):
+    """SkipGram words/s on a synthetic corpus (BASELINE config #4)."""
+    from deeplearning4j_tpu.nlp import CollectionSentenceIterator, Word2Vec
+
+    rs = np.random.RandomState(3)
+    vocab = [f"w{i}" for i in range(2000)]
+    zipf = rs.zipf(1.3, size=n_sentences * 20)
+    zipf = np.minimum(zipf - 1, len(vocab) - 1)
+    sentences = [" ".join(vocab[z] for z in zipf[i * 20:(i + 1) * 20])
+                 for i in range(n_sentences)]
+    w2v = Word2Vec(layer_size=128, window=5, min_word_frequency=2,
+                   negative=5, use_hierarchic_softmax=False, epochs=epochs,
+                   batch_size=8192)
+    w2v.build_vocab(sentences)
+    w2v.reset_weights()
+    total_words = n_sentences * 20 * epochs
+    t0 = time.perf_counter()
+    w2v.fit(CollectionSentenceIterator(sentences))
+    _sync(w2v.syn0)
+    return total_words / (time.perf_counter() - t0)
+
+
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
-    valid = ("all", "resnet50", "lenet", "lstm")
+    valid = ("all", "resnet50", "lenet", "lstm", "word2vec")
     if which not in valid:
         sys.exit(f"Unknown model '{which}'; choose one of {valid}")
     extras = {}
@@ -100,6 +122,10 @@ def main():
     if which in ("all", "lstm"):
         extras["textgen_lstm_tokens_s"] = round(bench_lstm(), 1)
         print(f"# lstm {extras['textgen_lstm_tokens_s']} tok/s",
+              file=sys.stderr)
+    if which in ("all", "word2vec"):
+        extras["word2vec_words_s"] = round(bench_word2vec(), 1)
+        print(f"# word2vec {extras['word2vec_words_s']} words/s",
               file=sys.stderr)
     if which in ("all", "resnet50"):
         v = bench_resnet50()

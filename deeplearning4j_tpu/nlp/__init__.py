@@ -1,0 +1,41 @@
+"""NLP embedding stack (reference: deeplearning4j-nlp-parent).
+
+- ``tokenization`` — tokenizer factories, sentence iterators, preprocessors
+  (reference: text/tokenization/, text/sentenceiterator/)
+- ``vocab`` — VocabWord, VocabCache, VocabConstructor, Huffman tree
+  (reference: models/word2vec/wordstore/, models/word2vec/Huffman.java)
+- ``learning`` — SkipGram/CBOW updates as single jitted scatter programs
+  (reference: models/embeddings/learning/impl/elements/)
+- ``sequence_vectors`` — the generic embedding trainer engine
+  (reference: models/sequencevectors/SequenceVectors.java)
+- ``word2vec`` / ``paragraph_vectors`` / ``glove`` — model facades
+  (reference: models/word2vec/, models/paragraphvectors/, models/glove/)
+- ``serde`` — word-vector serialization incl. Google word2vec binary format
+  (reference: models/embeddings/loader/WordVectorSerializer.java)
+- ``bagofwords`` — BoW / TF-IDF vectorizers (reference: bagofwords/)
+"""
+
+from deeplearning4j_tpu.nlp.vocab import (
+    AbstractCache,
+    Huffman,
+    VocabConstructor,
+    VocabWord,
+)
+from deeplearning4j_tpu.nlp.tokenization import (
+    CollectionSentenceIterator,
+    CommonPreprocessor,
+    DefaultTokenizerFactory,
+    FileSentenceIterator,
+    LineSentenceIterator,
+)
+from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+from deeplearning4j_tpu.nlp.glove import Glove
+
+__all__ = [
+    "AbstractCache", "Huffman", "VocabConstructor", "VocabWord",
+    "CollectionSentenceIterator", "CommonPreprocessor",
+    "DefaultTokenizerFactory", "FileSentenceIterator", "LineSentenceIterator",
+    "SequenceVectors", "Word2Vec", "ParagraphVectors", "Glove",
+]

@@ -1,0 +1,145 @@
+"""GloVe: co-occurrence weighted least squares (reference:
+models/glove/Glove.java:~60 builder, GloveWeightLookupTable AdaGrad update;
+Pennington et al. 2014).
+
+TPU-native: the co-occurrence counts accumulate in a host dict (sparse,
+data-dependent — wrong shape for XLA), then training runs as jitted AdaGrad
+batches over the nonzero (i, j, X_ij) triples: cost term
+f(X) * (w_i . w~_j + b_i + b~_j - log X)^2 with f(x) = (x/x_max)^alpha
+capped at 1.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import VocabConstructor
+
+
+@partial(jax.jit, static_argnames=())
+def _glove_step(W, Wc, b, bc, hW, hWc, hb, hbc, wi, wj, logx, fx, lr, eps):
+    """One AdaGrad batch over triples (wi, wj, X)."""
+    vi = W[wi]      # [B, D]
+    vj = Wc[wj]
+    diff = jnp.einsum("bd,bd->b", vi, vj) + b[wi] + bc[wj] - logx  # [B]
+    g = fx * diff  # [B]
+    gi = g[:, None] * vj
+    gj = g[:, None] * vi
+    gb = g
+    # AdaGrad accumulators
+    hW = hW.at[wi].add(gi * gi)
+    hWc = hWc.at[wj].add(gj * gj)
+    hb = hb.at[wi].add(gb * gb)
+    hbc = hbc.at[wj].add(gb * gb)
+    W = W.at[wi].add(-lr * gi / jnp.sqrt(hW[wi] + eps))
+    Wc = Wc.at[wj].add(-lr * gj / jnp.sqrt(hWc[wj] + eps))
+    b = b.at[wi].add(-lr * gb / jnp.sqrt(hb[wi] + eps))
+    bc = bc.at[wj].add(-lr * gb / jnp.sqrt(hbc[wj] + eps))
+    return W, Wc, b, bc, hW, hWc, hb, hbc
+
+
+class Glove:
+    def __init__(self, layer_size: int = 100, window: int = 15,
+                 min_word_frequency: int = 1, epochs: int = 25,
+                 learning_rate: float = 0.05, x_max: float = 100.0,
+                 alpha: float = 0.75, batch_size: int = 4096,
+                 symmetric: bool = True, seed: int = 12345,
+                 tokenizer_factory=None):
+        self.layer_size = layer_size
+        self.window = window
+        self.min_word_frequency = min_word_frequency
+        self.epochs = epochs
+        self.learning_rate = learning_rate
+        self.x_max = x_max
+        self.alpha = alpha
+        self.batch_size = batch_size
+        self.symmetric = symmetric
+        self.seed = seed
+        self.tokenizer_factory = tokenizer_factory or \
+            DefaultTokenizerFactory()
+        self.vocab = None
+        self.syn0 = None
+
+    def _cooccurrences(self, sentences) -> dict:
+        """Distance-weighted co-occurrence counts (reference:
+        glove/count/CoOccurrenceCounter; weight 1/d)."""
+        counts: dict = {}
+        for sentence in sentences:
+            toks = self.tokenizer_factory.create(sentence).tokens() \
+                if isinstance(sentence, str) else list(sentence)
+            idx = [self.vocab.index_of(t) for t in toks]
+            idx = [i for i in idx if i >= 0]
+            for i, wi in enumerate(idx):
+                for d in range(1, self.window + 1):
+                    j = i + d
+                    if j >= len(idx):
+                        break
+                    wj = idx[j]
+                    w = 1.0 / d
+                    counts[(wi, wj)] = counts.get((wi, wj), 0.0) + w
+                    if self.symmetric:
+                        counts[(wj, wi)] = counts.get((wj, wi), 0.0) + w
+        return counts
+
+    def fit(self, sentences) -> "Glove":
+        if self.vocab is None:
+            self.vocab = VocabConstructor(
+                min_word_frequency=self.min_word_frequency,
+                tokenizer_factory=self.tokenizer_factory,
+                build_huffman=False).build_vocab(
+                    s if isinstance(s, str) else " ".join(s)
+                    for s in sentences)
+        if hasattr(sentences, "reset"):
+            sentences.reset()
+        cooc = self._cooccurrences(sentences)
+        if not cooc:
+            raise ValueError("Empty co-occurrence matrix")
+        V, D = self.vocab.num_words(), self.layer_size
+        rng = np.random.RandomState(self.seed)
+        W = jnp.asarray((rng.random_sample((V, D)) - 0.5) / D, jnp.float32)
+        Wc = jnp.asarray((rng.random_sample((V, D)) - 0.5) / D, jnp.float32)
+        b = jnp.zeros(V, jnp.float32)
+        bc = jnp.zeros(V, jnp.float32)
+        hW = jnp.full((V, D), 1e-8, jnp.float32)
+        hWc = jnp.full((V, D), 1e-8, jnp.float32)
+        hb = jnp.full(V, 1e-8, jnp.float32)
+        hbc = jnp.full(V, 1e-8, jnp.float32)
+
+        keys = np.array(list(cooc.keys()), np.int32)
+        vals = np.array(list(cooc.values()), np.float32)
+        logx = np.log(vals)
+        fx = np.minimum((vals / self.x_max) ** self.alpha, 1.0) \
+            .astype(np.float32)
+        n = keys.shape[0]
+        order = np.arange(n)
+        for _ in range(self.epochs):
+            rng.shuffle(order)
+            for s in range(0, n, self.batch_size):
+                sl = order[s:s + self.batch_size]
+                (W, Wc, b, bc, hW, hWc, hb, hbc) = _glove_step(
+                    W, Wc, b, bc, hW, hWc, hb, hbc,
+                    jnp.asarray(keys[sl, 0]), jnp.asarray(keys[sl, 1]),
+                    jnp.asarray(logx[sl]), jnp.asarray(fx[sl]),
+                    jnp.float32(self.learning_rate), jnp.float32(1e-8))
+        # final embedding = W + Wc (standard GloVe practice)
+        self.syn0 = W + Wc
+        return self
+
+    # --------------------------------------------------------------- queries
+    def word_vector(self, word):
+        i = self.vocab.index_of(word)
+        return None if i < 0 else np.asarray(self.syn0[i])
+
+    def similarity(self, a: str, b: str) -> float:
+        ia, ib = self.vocab.index_of(a), self.vocab.index_of(b)
+        if ia < 0 or ib < 0:
+            return float("nan")
+        s = np.asarray(self.syn0)
+        va, vb = s[ia], s[ib]
+        return float(np.dot(va, vb) /
+                     max(np.linalg.norm(va) * np.linalg.norm(vb), 1e-12))

@@ -1,0 +1,197 @@
+"""SkipGram / CBOW training updates as single jitted XLA programs.
+
+Reference: models/embeddings/learning/impl/elements/SkipGram.java:215-272 —
+the reference fuses hierarchical softmax + negative sampling into the native
+``AggregateSkipGram`` ND4J op (per-pair dot/axpy on syn0/syn1 rows). The
+TPU-native equivalent batches B (center, context) pairs into index arrays and
+executes ONE jitted step per batch: gather rows -> sigmoid dots -> scatter-add
+updates (``.at[].add``, XLA scatter — duplicate indices accumulate, matching
+the reference's sequential row axpys up to summation order).
+
+Gradients are closed-form (logistic regression), not autodiff: the update is
+its own derivative, and hand-coding keeps it one fused kernel.
+
+HS pair layout: for each center/context pair, up to L huffman (point, code)
+levels with a validity mask. NS layout: K negatives per pair sampled on host
+from the unigram^0.75 table (reference: InMemoryLookupTable sampling table).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("use_hs", "use_ns"))
+def skipgram_step(syn0, syn1, syn1neg, centers, points, codes, code_mask,
+                  neg_targets, neg_labels, lr, *, use_hs: bool, use_ns: bool):
+    """One batched skipgram update.
+
+    syn0: [V, D] input vectors; syn1: [V, D] HS inner nodes; syn1neg: [V, D].
+    centers: [B] int32 — the word whose syn0 row moves.
+    points/codes/code_mask: [B, L] — HS path (padded).
+    neg_targets: [B, 1+K] (positive target first), neg_labels: [B, 1+K].
+    Returns updated (syn0, syn1, syn1neg).
+    """
+    h = syn0[centers]  # [B, D]
+    grad_h = jnp.zeros_like(h)
+
+    if use_hs:
+        w1 = syn1[points]  # [B, L, D]
+        f = jax.nn.sigmoid(jnp.einsum("bd,bld->bl", h, w1))
+        # g = (1 - code - f) * lr, masked (reference SkipGram HS sign form)
+        g = (1.0 - codes - f) * code_mask * lr
+        grad_h = grad_h + jnp.einsum("bl,bld->bd", g, w1)
+        dw1 = jnp.einsum("bl,bd->bld", g, h)
+        syn1 = syn1.at[points].add(dw1)
+
+    if use_ns:
+        wn = syn1neg[neg_targets]  # [B, 1+K, D]
+        f = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", h, wn))
+        g = (neg_labels - f) * lr
+        grad_h = grad_h + jnp.einsum("bk,bkd->bd", g, wn)
+        dwn = jnp.einsum("bk,bd->bkd", g, h)
+        syn1neg = syn1neg.at[neg_targets].add(dwn)
+
+    syn0 = syn0.at[centers].add(grad_h)
+    return syn0, syn1, syn1neg
+
+
+@partial(jax.jit, static_argnames=("use_hs", "use_ns"))
+def cbow_step(syn0, syn1, syn1neg, context, context_mask, points, codes,
+              code_mask, neg_targets, neg_labels, lr, *, use_hs: bool,
+              use_ns: bool):
+    """One batched CBOW update (reference: elements/CBOW.java — the context
+    mean predicts the center; the input gradient is spread over the context).
+
+    context: [B, C] int32 context-word ids (padded), context_mask: [B, C].
+    points/codes relate to the CENTER word's huffman path; neg_targets[...,0]
+    is the center (label 1).
+    """
+    ctx_vec = syn0[context]  # [B, C, D]
+    denom = jnp.maximum(context_mask.sum(axis=1, keepdims=True), 1.0)
+    h = (ctx_vec * context_mask[..., None]).sum(axis=1) / denom  # [B, D]
+    grad_h = jnp.zeros_like(h)
+
+    if use_hs:
+        w1 = syn1[points]
+        f = jax.nn.sigmoid(jnp.einsum("bd,bld->bl", h, w1))
+        g = (1.0 - codes - f) * code_mask * lr
+        grad_h = grad_h + jnp.einsum("bl,bld->bd", g, w1)
+        syn1 = syn1.at[points].add(jnp.einsum("bl,bd->bld", g, h))
+
+    if use_ns:
+        wn = syn1neg[neg_targets]
+        f = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", h, wn))
+        g = (neg_labels - f) * lr
+        grad_h = grad_h + jnp.einsum("bk,bkd->bd", g, wn)
+        syn1neg = syn1neg.at[neg_targets].add(jnp.einsum("bk,bd->bkd", g, h))
+
+    # spread input gradient over contributing context words (mean -> /count)
+    per_ctx = (grad_h[:, None, :] * context_mask[..., None]) / denom[..., None]
+    syn0 = syn0.at[context].add(per_ctx)
+    return syn0, syn1, syn1neg
+
+
+class BatchBuilder:
+    """Host-side pair/batch assembly shared by the elements learners.
+
+    Converts tokenized sentences into padded index arrays for the jitted
+    steps; implements the reference's dynamic window (b = rand % window),
+    subsampling, and unigram^0.75 negative table (reference:
+    InMemoryLookupTable.java:55-97,120 makeTable / SkipGram.java:215-224)."""
+
+    def __init__(self, cache, window=5, negative=0, use_hs=True,
+                 sampling=0.0, table_size=100000, seed=12345,
+                 max_code_length=40):
+        self.cache = cache
+        self.window = window
+        self.negative = int(negative)
+        self.use_hs = use_hs
+        self.sampling = sampling
+        self.rng = np.random.RandomState(seed)
+        self.max_code_len = max(
+            (len(cache.element_at_index(i).codes)
+             for i in range(cache.num_words())), default=1) or 1
+        self.max_code_len = min(self.max_code_len, max_code_length)
+        counts = cache.counts_array()
+        if self.negative > 0 and counts.size:
+            p = counts ** 0.75
+            self._neg_cum = np.cumsum(p / p.sum())
+        else:
+            self._neg_cum = None
+        # precomputed huffman path arrays [V, L]
+        V = cache.num_words()
+        L = self.max_code_len
+        self.points = np.zeros((V, L), np.int32)
+        self.codes = np.zeros((V, L), np.float32)
+        self.code_mask = np.zeros((V, L), np.float32)
+        for i in range(V):
+            w = cache.element_at_index(i)
+            n = min(len(w.codes), L)
+            if n:
+                self.points[i, :n] = w.points[:n]
+                self.codes[i, :n] = w.codes[:n]
+                self.code_mask[i, :n] = 1.0
+
+    def sentence_to_indices(self, tokens) -> np.ndarray:
+        idx = [self.cache.index_of(t) for t in tokens]
+        idx = np.array([i for i in idx if i >= 0], np.int32)
+        if self.sampling > 0 and idx.size:
+            counts = self.cache.counts_array()
+            total = self.cache.total_word_count
+            freq = counts[idx] / total
+            # word2vec subsampling keep probability
+            keep_p = (np.sqrt(freq / self.sampling) + 1) * self.sampling / freq
+            idx = idx[self.rng.random_sample(idx.size) < keep_p]
+        return idx
+
+    def pairs_from_sentence(self, idx: np.ndarray):
+        """(centers, contexts) with the reference's shrinking random window
+        (b = rand % window), vectorised: one boolean mask per offset d in
+        [-window, window] instead of a per-word python loop."""
+        n = idx.size
+        if n < 2:
+            return np.zeros(0, np.int32), np.zeros(0, np.int32)
+        win = self.window - self.rng.randint(0, self.window, size=n)  # [n]
+        pos = np.arange(n)
+        centers, contexts = [], []
+        for d in range(-self.window, self.window + 1):
+            if d == 0:
+                continue
+            j = pos + d
+            m = (np.abs(d) <= win) & (j >= 0) & (j < n)
+            if m.any():
+                centers.append(idx[pos[m]])
+                contexts.append(idx[j[m]])
+        if not centers:
+            return np.zeros(0, np.int32), np.zeros(0, np.int32)
+        return (np.concatenate(centers).astype(np.int32),
+                np.concatenate(contexts).astype(np.int32))
+
+    def sample_negatives(self, positives: np.ndarray,
+                         rng: Optional[np.random.RandomState] = None
+                         ) -> np.ndarray:
+        """[B] -> [B, 1+K] target ids, positive first. ``rng`` overrides the
+        builder's stream (deterministic inference)."""
+        B, K = positives.size, self.negative
+        targets = np.empty((B, 1 + K), np.int32)
+        targets[:, 0] = positives
+        if K:
+            u = (rng or self.rng).random_sample((B, K))
+            targets[:, 1:] = np.searchsorted(self._neg_cum, u).astype(np.int32)
+        return targets
+
+    def neg_labels(self, B: int) -> np.ndarray:
+        lab = np.zeros((B, 1 + self.negative), np.float32)
+        lab[:, 0] = 1.0
+        return lab
+
+    def hs_arrays(self, predicted: np.ndarray):
+        """Huffman paths for the predicted words: ([B,L] points, codes, mask)."""
+        return (self.points[predicted], self.codes[predicted],
+                self.code_mask[predicted])

@@ -1,0 +1,176 @@
+"""ParagraphVectors / doc2vec (reference:
+models/paragraphvectors/ParagraphVectors.java:47, sequence learning
+impls models/embeddings/learning/impl/sequence/DBOW.java + DM.java).
+
+Labels live in the same lookup table as words (reference behavior): each
+label gets a vocab entry and a syn0 row. DBOW: the label row predicts each
+word of its document through the word's HS path / negatives — exactly the
+skipgram step with the label as the moving row. DM: the label is prepended
+to every CBOW context window.
+
+``infer_vector`` trains a fresh row against frozen syn1/syn1neg (reference:
+ParagraphVectors.inferVector), as one jitted loop per iteration.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.learning import skipgram_step
+from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+from deeplearning4j_tpu.nlp.vocab import Huffman, VocabWord
+
+
+@partial(jax.jit, static_argnames=("use_hs", "use_ns"))
+def _infer_step(vec, syn1, syn1neg, points, codes, code_mask, neg_targets,
+                neg_labels, lr, *, use_hs: bool, use_ns: bool):
+    """DBOW inference: move only ``vec`` [D]; syn1/syn1neg frozen."""
+    grad = jnp.zeros_like(vec)
+    if use_hs:
+        w1 = syn1[points]  # [B, L, D]
+        f = jax.nn.sigmoid(jnp.einsum("d,bld->bl", vec, w1))
+        g = (1.0 - codes - f) * code_mask * lr
+        grad = grad + jnp.einsum("bl,bld->d", g, w1)
+    if use_ns:
+        wn = syn1neg[neg_targets]
+        f = jax.nn.sigmoid(jnp.einsum("d,bkd->bk", vec, wn))
+        g = (neg_labels - f) * lr
+        grad = grad + jnp.einsum("bk,bkd->d", g, wn)
+    return vec + grad
+
+
+class ParagraphVectors(SequenceVectors):
+    """reference: ParagraphVectors.java:47 (builder + inferVector :~300)."""
+
+    LABEL_PREFIX = "__label__"
+
+    def __init__(self, sequence_algorithm: str = "dbow",
+                 train_words: bool = False, **kw):
+        kw.setdefault("elements_algorithm", "skipgram")
+        super().__init__(**kw)
+        self.sequence_algorithm = sequence_algorithm.lower()
+        self.train_words = train_words
+        self._label_ids: dict = {}
+
+    # ------------------------------------------------------------------ vocab
+    def _label_token(self, label: str) -> str:
+        return self.LABEL_PREFIX + label
+
+    def build_vocab_from_documents(self, documents) -> None:
+        contents = [d.content for d in documents]
+        self.build_vocab(contents)
+        # add labels to the vocab (no huffman path needed for labels — they
+        # are never predicted, only predictors), then rebuild indices+tree
+        for d in documents:
+            for label in d.labels:
+                t = self._label_token(label)
+                if not self.vocab.contains_word(t):
+                    self.vocab.add_token(VocabWord(t, 1.0))
+        self.vocab.update_indices()
+        Huffman(self.vocab).build()
+
+    # -------------------------------------------------------------------- fit
+    def fit(self, documents) -> "ParagraphVectors":
+        documents = list(documents)
+        if self.vocab is None:
+            self.build_vocab_from_documents(documents)
+        if self.syn0 is None:
+            self.reset_weights()
+        self._label_ids = {
+            label: self.vocab.index_of(self._label_token(label))
+            for d in documents for label in d.labels}
+        total = max(sum(len(d.content.split()) for d in documents), 1)
+        total *= self.epochs
+        seen = 0
+        for _ in range(self.epochs):
+            for d in documents:
+                tokens = self.tokenizer_factory.create(d.content).tokens()
+                idx = self._builder.sentence_to_indices(tokens)
+                if idx.size == 0:
+                    continue
+                lr = self._alpha(seen / total)
+                label_ids = np.asarray(
+                    [self.vocab.index_of(self._label_token(l))
+                     for l in d.labels], np.int32)
+                if self.sequence_algorithm == "dbow":
+                    self._fit_dbow(idx, label_ids, lr)
+                elif self.sequence_algorithm == "dm":
+                    self._fit_dm(idx, label_ids, lr)
+                else:
+                    raise ValueError(
+                        f"Unknown sequence algorithm "
+                        f"'{self.sequence_algorithm}'")
+                if self.train_words:
+                    self._train_indexed(idx, seen / total)
+                seen += idx.size
+        return self
+
+    def _fit_dbow(self, idx, label_ids, lr):
+        """Label row predicts every doc word (reference: DBOW.java)."""
+        for lab in label_ids:
+            rows = np.full(idx.size, lab, np.int32)
+            for s in range(0, idx.size, self.batch_size):
+                sl = slice(s, s + self.batch_size)
+                self._skipgram_batch(rows[sl], idx[sl], lr)
+
+    def _fit_dm(self, idx, label_ids, lr):
+        """Label + window context predicts center (reference: DM.java)."""
+        for lab in label_ids:
+            extra = np.full(idx.size, lab, np.int32)
+            self._cbow_sentence(idx, lr, extra_context=extra)
+
+    # ------------------------------------------------------------- inference
+    def infer_vector(self, text: str, learning_rate: float = 0.01,
+                     iterations: int = 5, seed: int = 0) -> np.ndarray:
+        """Train a fresh paragraph vector for unseen text (reference:
+        ParagraphVectors.inferVector)."""
+        tokens = self.tokenizer_factory.create(text).tokens()
+        idx = self._builder.sentence_to_indices(tokens)
+        rng = np.random.RandomState(seed)
+        vec = jnp.asarray(
+            (rng.random_sample(self.layer_size) - 0.5) / self.layer_size,
+            jnp.float32)
+        if idx.size == 0:
+            return np.asarray(vec)
+        b = self._builder
+        points, codes, mask = b.hs_arrays(idx)
+        neg_rng = np.random.RandomState(seed + 1)
+        for _ in range(iterations):
+            negs = b.sample_negatives(idx, rng=neg_rng)
+            vec = _infer_step(vec, self.syn1, self.syn1neg,
+                              jnp.asarray(points), jnp.asarray(codes),
+                              jnp.asarray(mask), jnp.asarray(negs),
+                              jnp.asarray(b.neg_labels(idx.size)),
+                              jnp.float32(learning_rate),
+                              use_hs=self.use_hs, use_ns=self.negative > 0)
+        return np.asarray(vec)
+
+    # ------------------------------------------------------------- query API
+    def labels(self) -> list:
+        return list(self._label_ids)
+
+    def label_vector(self, label: str) -> np.ndarray:
+        return np.asarray(self.syn0[self._label_ids[label]])
+
+    def similarity_to_label(self, text: str, label: str) -> float:
+        v = self.infer_vector(text)
+        lv = self.label_vector(label)
+        denom = max(np.linalg.norm(v) * np.linalg.norm(lv), 1e-12)
+        return float(np.dot(v, lv) / denom)
+
+    def predict(self, text: str) -> str:
+        """Nearest label for unseen text (reference:
+        ParagraphVectors.predict)."""
+        v = self.infer_vector(text)
+        best, best_sim = None, -2.0
+        for label in self._label_ids:
+            lv = self.label_vector(label)
+            denom = max(np.linalg.norm(v) * np.linalg.norm(lv), 1e-12)
+            sim = float(np.dot(v, lv) / denom)
+            if sim > best_sim:
+                best, best_sim = label, sim
+        return best

@@ -1,0 +1,92 @@
+"""Word-vector serialization (reference:
+models/embeddings/loader/WordVectorSerializer.java — Google word2vec binary
+format read/write + plain-text format)."""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+
+def write_word2vec_binary(model, path: str) -> None:
+    """Google word2vec .bin format: header 'V D\\n', then per word:
+    'word '<D float32 little-endian>'\\n' (reference:
+    WordVectorSerializer.writeWordVectors binary path)."""
+    syn0 = np.asarray(model.syn0, np.float32)
+    V, D = syn0.shape
+    with open(path, "wb") as f:
+        f.write(f"{V} {D}\n".encode())
+        for i in range(V):
+            word = model.vocab.word_at_index(i)
+            f.write(word.encode("utf-8") + b" ")
+            f.write(syn0[i].astype("<f4").tobytes())
+            f.write(b"\n")
+
+
+def read_word2vec_binary(path: str):
+    """-> (words list, [V, D] float32). Tolerates the optional trailing
+    newline per row (both classic layouts exist in the wild)."""
+    with open(path, "rb") as f:
+        header = b""
+        while not header.endswith(b"\n"):
+            header += f.read(1)
+        V, D = map(int, header.split())
+        words, vecs = [], np.empty((V, D), np.float32)
+        for i in range(V):
+            w = b""
+            while True:
+                c = f.read(1)
+                if c in (b" ", b""):
+                    break
+                if c != b"\n":
+                    w += c
+            words.append(w.decode("utf-8", errors="replace"))
+            vecs[i] = np.frombuffer(f.read(4 * D), "<f4")
+    return words, vecs
+
+
+def write_word_vectors_text(model, path: str) -> None:
+    """Plain text: 'word v1 v2 ...' per line (reference:
+    WordVectorSerializer.writeWordVectors)."""
+    syn0 = np.asarray(model.syn0)
+    with open(path, "w", encoding="utf-8") as f:
+        for i in range(syn0.shape[0]):
+            vec = " ".join(f"{x:.6f}" for x in syn0[i])
+            f.write(f"{model.vocab.word_at_index(i)} {vec}\n")
+
+
+def read_word_vectors_text(path: str):
+    words, rows = [], []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            parts = line.rstrip("\n").split(" ")
+            if len(parts) < 2:
+                continue
+            words.append(parts[0])
+            rows.append([float(x) for x in parts[1:]])
+    return words, np.asarray(rows, np.float32)
+
+
+def load_word2vec(path: str, binary: bool = True):
+    """-> a queryable Word2Vec with vocab + vectors, no training state
+    (reference: WordVectorSerializer.loadGoogleModel)."""
+    from deeplearning4j_tpu.nlp.vocab import AbstractCache, VocabWord
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+    words, vecs = (read_word2vec_binary(path) if binary
+                   else read_word_vectors_text(path))
+    import jax.numpy as jnp
+
+    m = Word2Vec(layer_size=vecs.shape[1])
+    cache = AbstractCache()
+    # preserve file order as index order: descending pseudo-frequency
+    for r, w in enumerate(words):
+        cache.add_token(VocabWord(w, count=float(len(words) - r)))
+    cache.update_indices()
+    m.vocab = cache
+    order = np.asarray([cache.index_of(w) for w in words])
+    syn0 = np.empty_like(vecs)
+    syn0[order] = vecs
+    m.syn0 = jnp.asarray(syn0)
+    return m

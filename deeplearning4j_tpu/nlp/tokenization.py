@@ -1,0 +1,197 @@
+"""Text pipeline: tokenizers, preprocessors, sentence iterators.
+
+Reference: deeplearning4j-nlp text/tokenization/tokenizerfactory/
+(DefaultTokenizerFactory, TokenizerFactory SPI), tokenizer/preprocessor/
+(CommonPreprocessor, EndingPreProcessor), text/sentenceiterator/
+(CollectionSentenceIterator, FileSentenceIterator, LineSentenceIterator,
+LabelAwareSentenceIterator). Vendored CJK analyzers (ansj/kuromoji, ~17k LoC
+of third-party Java) are out of scope; the TokenizerFactory SPI is the hook
+where equivalents would plug in.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import string
+from typing import Iterable, Optional
+
+
+class TokenPreProcess:
+    """reference: tokenization/tokenizer/TokenPreProcess.java"""
+
+    def pre_process(self, token: str) -> str:
+        raise NotImplementedError
+
+
+class CommonPreprocessor(TokenPreProcess):
+    """Lowercase + strip punctuation/digits-adjacent junk (reference:
+    tokenization/tokenizer/preprocessor/CommonPreprocessor.java)."""
+
+    _PUNCT = re.compile(r"[\d.:,\"'()\[\]|/?!;]+")
+
+    def pre_process(self, token: str) -> str:
+        return self._PUNCT.sub("", token.lower())
+
+
+class LowCasePreProcessor(TokenPreProcess):
+    def pre_process(self, token: str) -> str:
+        return token.lower()
+
+
+class EndingPreProcessor(TokenPreProcess):
+    """Crude stemmer (reference: preprocessor/EndingPreProcessor.java)."""
+
+    def pre_process(self, token: str) -> str:
+        for end in ("s", "ly", "ed", "ing", "ness"):
+            if token.endswith(end) and len(token) > len(end) + 2:
+                return token[:-len(end)]
+        return token
+
+
+class Tokenizer:
+    """reference: tokenization/tokenizer/Tokenizer.java (iterator API
+    collapsed to a list-returning ``tokens()``)"""
+
+    def __init__(self, text: str, preprocessor: Optional[TokenPreProcess]):
+        self._tokens = [t for t in text.split() if t]
+        self._pre = preprocessor
+
+    def tokens(self) -> list:
+        if self._pre is None:
+            return list(self._tokens)
+        out = []
+        for t in self._tokens:
+            p = self._pre.pre_process(t)
+            if p:
+                out.append(p)
+        return out
+
+    def count_tokens(self) -> int:
+        return len(self._tokens)
+
+
+class TokenizerFactory:
+    """reference: tokenizerfactory/TokenizerFactory.java SPI"""
+
+    def create(self, text: str) -> Tokenizer:
+        raise NotImplementedError
+
+    def set_token_pre_processor(self, pre: TokenPreProcess) -> None:
+        raise NotImplementedError
+
+
+class DefaultTokenizerFactory(TokenizerFactory):
+    """Whitespace tokenizer (reference:
+    tokenizerfactory/DefaultTokenizerFactory.java)."""
+
+    def __init__(self, preprocessor: Optional[TokenPreProcess] = None):
+        self._pre = preprocessor
+
+    def create(self, text: str) -> Tokenizer:
+        return Tokenizer(text, self._pre)
+
+    def set_token_pre_processor(self, pre: TokenPreProcess) -> None:
+        self._pre = pre
+
+
+class NGramTokenizerFactory(TokenizerFactory):
+    """n-gram over a base tokenizer (reference:
+    tokenizerfactory/NGramTokenizerFactory.java)."""
+
+    def __init__(self, base: TokenizerFactory, min_n: int, max_n: int):
+        self.base = base
+        self.min_n = min_n
+        self.max_n = max_n
+
+    def create(self, text: str) -> Tokenizer:
+        toks = self.base.create(text).tokens()
+        grams = []
+        for n in range(self.min_n, self.max_n + 1):
+            for i in range(len(toks) - n + 1):
+                grams.append(" ".join(toks[i:i + n]))
+        t = Tokenizer("", None)
+        t._tokens = grams
+        return t
+
+    def set_token_pre_processor(self, pre: TokenPreProcess) -> None:
+        self.base.set_token_pre_processor(pre)
+
+
+# ------------------------------------------------------------------ iterators
+class SentenceIterator:
+    """reference: text/sentenceiterator/SentenceIterator.java"""
+
+    def __iter__(self):
+        self.reset()
+        return self._gen()
+
+    def _gen(self):
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        pass
+
+
+class CollectionSentenceIterator(SentenceIterator):
+    def __init__(self, sentences: Iterable[str]):
+        self.sentences = list(sentences)
+
+    def _gen(self):
+        yield from self.sentences
+
+
+class LineSentenceIterator(SentenceIterator):
+    """One sentence per line of a file (reference:
+    sentenceiterator/LineSentenceIterator.java)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def _gen(self):
+        with open(self.path, encoding="utf-8", errors="ignore") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    yield line
+
+
+class FileSentenceIterator(SentenceIterator):
+    """All files under a directory, line per sentence (reference:
+    sentenceiterator/FileSentenceIterator.java)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+
+    def _gen(self):
+        for root, _, files in os.walk(self.directory):
+            for fn in sorted(files):
+                with open(os.path.join(root, fn), encoding="utf-8",
+                          errors="ignore") as f:
+                    for line in f:
+                        line = line.strip()
+                        if line:
+                            yield line
+
+
+class LabelledDocument:
+    """reference: text/documentiterator/LabelledDocument.java"""
+
+    def __init__(self, content: str, labels):
+        self.content = content
+        self.labels = labels if isinstance(labels, (list, tuple)) \
+            else [labels]
+
+
+class LabelAwareIterator:
+    """Documents with labels, for ParagraphVectors (reference:
+    text/documentiterator/LabelAwareIterator.java)."""
+
+    def __init__(self, documents: Iterable[LabelledDocument]):
+        self.documents = list(documents)
+
+    def __iter__(self):
+        return iter(self.documents)
+
+    def reset(self):
+        pass

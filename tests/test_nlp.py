@@ -1,0 +1,303 @@
+"""NLP stack tests (ports the intent of deeplearning4j-nlp tests:
+Word2VecTests, ParagraphVectorsTest, GloveTest, vocab/Huffman tests,
+TfidfVectorizerTest)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    CollectionSentenceIterator,
+    CommonPreprocessor,
+    DefaultTokenizerFactory,
+    Glove,
+    Huffman,
+    ParagraphVectors,
+    VocabConstructor,
+    Word2Vec,
+)
+from deeplearning4j_tpu.nlp.bagofwords import (
+    BagOfWordsVectorizer,
+    TfidfVectorizer,
+)
+from deeplearning4j_tpu.nlp.tokenization import LabelledDocument
+
+
+def _synthetic_corpus(n=300, seed=0):
+    """Two topic clusters: 'day/sun/light/bright' vs 'night/moon/dark/star'.
+    Co-occurrence structure is what the embeddings must discover."""
+    rs = np.random.RandomState(seed)
+    day = ["day", "sun", "light", "bright", "warm", "noon"]
+    night = ["night", "moon", "dark", "star", "cold", "midnight"]
+    filler = ["the", "a", "is", "was", "and"]
+    sentences = []
+    for _ in range(n):
+        topic = day if rs.rand() < 0.5 else night
+        words = []
+        for _ in range(rs.randint(5, 9)):
+            words.append(topic[rs.randint(len(topic))]
+                         if rs.rand() < 0.75
+                         else filler[rs.randint(len(filler))])
+        sentences.append(" ".join(words))
+    return sentences
+
+
+class TestTokenization:
+    def test_default_tokenizer_with_preprocessor(self):
+        tf = DefaultTokenizerFactory(CommonPreprocessor())
+        toks = tf.create("Hello, World! 123 foo-bar").tokens()
+        assert "hello" in toks and "world" in toks
+        assert all("," not in t and "!" not in t for t in toks)
+
+    def test_sentence_iterator_reset(self):
+        it = CollectionSentenceIterator(["a b", "c d"])
+        assert list(it) == ["a b", "c d"]
+        assert list(it) == ["a b", "c d"]  # re-iterable
+
+
+class TestVocab:
+    def test_vocab_counts_and_min_frequency(self):
+        vc = VocabConstructor(min_word_frequency=2)
+        cache = vc.build_vocab(["a a a b b c", "a b d"])
+        assert cache.word_frequency("a") == 4
+        assert cache.word_frequency("b") == 3
+        assert not cache.contains_word("c")  # freq 1 < 2
+        assert not cache.contains_word("d")
+        # index 0 = most frequent
+        assert cache.word_at_index(0) == "a"
+
+    def test_huffman_codes_valid(self):
+        """Huffman: prefix-free codes, frequent words get shorter codes
+        (reference: Huffman.java:34)."""
+        vc = VocabConstructor(min_word_frequency=1)
+        cache = vc.build_vocab(
+            ["a a a a a a a a b b b b c c d d e f g h i j"])
+        wa = cache.word_for("a")
+        wj = cache.word_for("j")
+        assert len(wa.codes) <= len(wj.codes)
+        # prefix-free: no word's code is a prefix of another's
+        codes = {"".join(map(str, cache.word_for(w).codes))
+                 for w in "abcdefghij"}
+        assert len(codes) == 10
+        for c1 in codes:
+            for c2 in codes:
+                if c1 != c2:
+                    assert not c2.startswith(c1)
+        # points within inner-node space [0, V-1)
+        V = cache.num_words()
+        for w in "abcdefghij":
+            ww = cache.word_for(w)
+            assert len(ww.points) == len(ww.codes)
+            assert all(0 <= p < V - 1 for p in ww.points)
+
+
+class TestWord2Vec:
+    @pytest.mark.parametrize("hs,negative,lr", [(True, 0, 0.05),
+                                                (False, 5, 0.05),
+                                                (True, 5, 0.025)])
+    def test_skipgram_learns_topic_structure(self, hs, negative, lr):
+        # combined HS+NS doubles the per-pair step; with this tiny 17-word
+        # vocab the batched scatter update needs the word2vec-default lr and
+        # a smaller batch to stay stable (real vocabs spread the rows)
+        corpus = _synthetic_corpus()
+        w2v = Word2Vec(layer_size=32, window=4, min_word_frequency=3,
+                       epochs=4, use_hierarchic_softmax=hs,
+                       negative=negative, learning_rate=lr,
+                       batch_size=128 if (hs and negative) else 512, seed=7)
+        w2v.fit(CollectionSentenceIterator(corpus))
+        # within-topic similarity must beat cross-topic
+        same = w2v.similarity("day", "sun")
+        cross = w2v.similarity("day", "moon")
+        assert same > cross, (same, cross)
+        assert w2v.similarity("night", "moon") > \
+            w2v.similarity("night", "sun")
+
+    def test_cbow_learns_topic_structure(self):
+        corpus = _synthetic_corpus()
+        w2v = Word2Vec(layer_size=32, window=4, min_word_frequency=3,
+                       epochs=6, negative=5, use_hierarchic_softmax=False,
+                       elements_algorithm="cbow", learning_rate=0.05, seed=3)
+        w2v.fit(CollectionSentenceIterator(corpus))
+        assert w2v.similarity("day", "sun") > w2v.similarity("day", "moon")
+
+    def test_words_nearest(self):
+        corpus = _synthetic_corpus()
+        w2v = Word2Vec(layer_size=32, window=4, min_word_frequency=3,
+                       epochs=4, negative=5, seed=11)
+        w2v.fit(CollectionSentenceIterator(corpus))
+        near = [w for w, _ in w2v.words_nearest("moon", 4)]
+        assert len(near) == 4
+        assert "moon" not in near
+        night_words = {"night", "dark", "star", "cold", "midnight"}
+        assert len(night_words & set(near)) >= 1
+
+    def test_binary_serde_roundtrip(self, tmp_path):
+        from deeplearning4j_tpu.nlp.serde import (
+            load_word2vec,
+            write_word2vec_binary,
+        )
+
+        corpus = _synthetic_corpus(100)
+        w2v = Word2Vec(layer_size=16, min_word_frequency=2, epochs=1,
+                       negative=3, seed=5)
+        w2v.fit(CollectionSentenceIterator(corpus))
+        p = str(tmp_path / "vecs.bin")
+        write_word2vec_binary(w2v, p)
+        m2 = load_word2vec(p, binary=True)
+        for w in ("day", "night", "the"):
+            if w2v.has_word(w):
+                assert np.allclose(w2v.word_vector(w), m2.word_vector(w),
+                                   atol=1e-6)
+        assert abs(w2v.similarity("day", "sun")
+                   - m2.similarity("day", "sun")) < 1e-5
+
+    def test_text_serde_roundtrip(self, tmp_path):
+        from deeplearning4j_tpu.nlp.serde import (
+            load_word2vec,
+            write_word_vectors_text,
+        )
+
+        w2v = Word2Vec(layer_size=8, min_word_frequency=1, epochs=1,
+                       negative=2, seed=5)
+        w2v.fit(CollectionSentenceIterator(["a b c a b", "b c a"]))
+        p = str(tmp_path / "vecs.txt")
+        write_word_vectors_text(w2v, p)
+        m2 = load_word2vec(p, binary=False)
+        assert np.allclose(w2v.word_vector("a"), m2.word_vector("a"),
+                           atol=1e-5)
+
+
+class TestSkipGramGradient:
+    def test_hs_update_matches_autodiff(self):
+        """The closed-form HS update must equal -lr * dLoss/dparams for the
+        binary cross-entropy along the huffman path (gradcheck of the fused
+        op, parity with the reference's AggregateSkipGram semantics)."""
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nlp.learning import skipgram_step
+
+        V, D, L = 7, 5, 3
+        rs = np.random.RandomState(0)
+        syn0 = jnp.asarray(rs.randn(V, D), jnp.float32) * 0.1
+        syn1 = jnp.asarray(rs.randn(V, D), jnp.float32) * 0.1
+        centers = jnp.asarray([2], jnp.int32)
+        points = jnp.asarray([[0, 3, 4]], jnp.int32)
+        codes = jnp.asarray([[1.0, 0.0, 1.0]], jnp.float32)
+        mask = jnp.ones((1, L), jnp.float32)
+        lr = 0.1
+
+        def hs_loss(s0, s1):
+            h = s0[centers]  # [1, D]
+            f = jax.nn.sigmoid(jnp.einsum("bd,bld->bl", h, s1[points]))
+            # BCE with target (1 - code)
+            t = 1.0 - codes
+            return -jnp.sum(t * jnp.log(f + 1e-12)
+                            + (1 - t) * jnp.log(1 - f + 1e-12))
+
+        g0, g1 = jax.grad(hs_loss, argnums=(0, 1))(syn0, syn1)
+        new0, new1, _ = skipgram_step(
+            syn0, syn1, jnp.zeros_like(syn1), centers, points, codes, mask,
+            jnp.zeros((1, 1), jnp.int32), jnp.zeros((1, 1), jnp.float32),
+            jnp.float32(lr), use_hs=True, use_ns=False)
+        assert np.allclose(np.asarray(new0), np.asarray(syn0 - lr * g0),
+                           atol=1e-5)
+        assert np.allclose(np.asarray(new1), np.asarray(syn1 - lr * g1),
+                           atol=1e-5)
+
+    def test_ns_update_matches_autodiff(self):
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nlp.learning import skipgram_step
+
+        V, D, K = 6, 4, 3
+        rs = np.random.RandomState(1)
+        syn0 = jnp.asarray(rs.randn(V, D), jnp.float32) * 0.1
+        syn1neg = jnp.asarray(rs.randn(V, D), jnp.float32) * 0.1
+        centers = jnp.asarray([1, 4], jnp.int32)
+        negt = jnp.asarray([[2, 0, 3, 5], [0, 2, 3, 1]], jnp.int32)
+        negl = jnp.asarray([[1, 0, 0, 0], [1, 0, 0, 0]], jnp.float32)
+        lr = 0.05
+
+        def ns_loss(s0, sn):
+            h = s0[centers]
+            f = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", h, sn[negt]))
+            return -jnp.sum(negl * jnp.log(f + 1e-12)
+                            + (1 - negl) * jnp.log(1 - f + 1e-12))
+
+        g0, gn = jax.grad(ns_loss, argnums=(0, 1))(syn0, syn1neg)
+        new0, _, newn = skipgram_step(
+            syn0, jnp.zeros_like(syn0), syn1neg, centers,
+            jnp.zeros((2, 1), jnp.int32), jnp.zeros((2, 1), jnp.float32),
+            jnp.zeros((2, 1), jnp.float32), negt, negl,
+            jnp.float32(lr), use_hs=False, use_ns=True)
+        assert np.allclose(np.asarray(new0), np.asarray(syn0 - lr * g0),
+                           atol=1e-5)
+        assert np.allclose(np.asarray(newn), np.asarray(syn1neg - lr * gn),
+                           atol=1e-5)
+
+
+class TestParagraphVectors:
+    def _docs(self, n=120, seed=2):
+        rs = np.random.RandomState(seed)
+        day = ["day", "sun", "light", "bright", "warm"]
+        night = ["night", "moon", "dark", "star", "cold"]
+        docs = []
+        for i in range(n):
+            topic, label = (day, "DAY") if rs.rand() < 0.5 else \
+                (night, "NIGHT")
+            words = [topic[rs.randint(len(topic))]
+                     for _ in range(rs.randint(6, 10))]
+            docs.append(LabelledDocument(" ".join(words), label))
+        return docs
+
+    @pytest.mark.parametrize("algo", ["dbow", "dm"])
+    def test_doc_classification(self, algo):
+        docs = self._docs()
+        pv = ParagraphVectors(layer_size=24, window=3, min_word_frequency=2,
+                              epochs=6, negative=5,
+                              use_hierarchic_softmax=False,
+                              sequence_algorithm=algo, learning_rate=0.05,
+                              seed=9)
+        pv.fit(docs)
+        assert set(pv.labels()) == {"DAY", "NIGHT"}
+        assert pv.predict("sun light warm bright day sun") == "DAY"
+        assert pv.predict("moon dark star cold night moon") == "NIGHT"
+
+    def test_infer_vector_consistency(self):
+        docs = self._docs()
+        pv = ParagraphVectors(layer_size=24, window=3, min_word_frequency=2,
+                              epochs=5, negative=5,
+                              use_hierarchic_softmax=False, seed=4)
+        pv.fit(docs)
+        v1 = pv.infer_vector("sun light warm", iterations=10, seed=0)
+        v2 = pv.infer_vector("sun light warm", iterations=10, seed=0)
+        assert np.allclose(v1, v2)  # deterministic
+        assert v1.shape == (24,)
+
+
+class TestGlove:
+    def test_glove_learns_topic_structure(self):
+        corpus = _synthetic_corpus(250)
+        g = Glove(layer_size=24, window=6, min_word_frequency=3, epochs=40,
+                  learning_rate=0.1, seed=13)
+        g.fit(corpus)
+        assert g.similarity("day", "sun") > g.similarity("day", "moon")
+
+
+class TestBagOfWords:
+    def test_counts(self):
+        bow = BagOfWordsVectorizer()
+        X = bow.fit_transform(["a b a", "b c"])
+        ia = bow.vocab.index_of("a")
+        assert X[0, ia] == 2.0
+        assert X[1, ia] == 0.0
+
+    def test_tfidf_downweights_common_words(self):
+        docs = ["the cat sat", "the dog ran", "the bird flew"]
+        tv = TfidfVectorizer().fit(docs)
+        v = tv.transform("the cat")
+        i_the = tv.vocab.index_of("the")
+        i_cat = tv.vocab.index_of("cat")
+        assert v[i_the] == 0.0          # idf(the) = log(3/3) = 0
+        assert v[i_cat] > 0.0
