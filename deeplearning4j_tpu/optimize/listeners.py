@@ -117,6 +117,33 @@ class TimeIterationListener(TrainingListener):
                      self.total_iterations, remaining)
 
 
+class ProfilerListener(TrainingListener):
+    """Capture a jax.profiler device trace for a window of iterations
+    (SURVEY §5 tracing: the reference has only wall-clock listeners; on TPU
+    the jax profiler gives per-op device timelines viewable in
+    TensorBoard/Perfetto). Starts at ``start_iteration``, stops after
+    ``num_iterations``; writes to ``log_dir``."""
+
+    def __init__(self, log_dir: str, start_iteration: int = 10,
+                 num_iterations: int = 5):
+        self.log_dir = log_dir
+        self.start_iteration = start_iteration
+        self.stop_iteration = start_iteration + num_iterations
+        self._active = False
+
+    def iteration_done(self, model, iteration: int):
+        import jax
+
+        if not self._active and iteration >= self.start_iteration \
+                and iteration < self.stop_iteration:
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+        elif self._active and iteration >= self.stop_iteration:
+            jax.profiler.stop_trace()
+            self._active = False
+            log.info("profiler trace written to %s", self.log_dir)
+
+
 class ModelSavingCallback(TrainingListener):
     """Save checkpoints every N iterations (reference:
     optimize/listeners/callbacks/ModelSavingCallback.java)."""

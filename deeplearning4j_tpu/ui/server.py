@@ -1,0 +1,183 @@
+"""UIServer: HTTP view over attached StatsStorage instances.
+
+Reference: deeplearning4j-play PlayUIServer.java:53 (+ UIServer.java:24
+singleton attach/detach) and the TrainModule overview route. Play+Scala
+templates are replaced by Python's http.server with JSON endpoints and one
+inline-JS overview page (no external dependencies):
+
+- GET /train/sessions               -> session ids
+- GET /train/overview?sid=...       -> score/time series + latest norms
+- GET /train/model?sid=...          -> static model info
+- POST /remoteReceive               -> RemoteUIStatsStorageRouter sink
+- GET /                             -> HTML overview (score chart via canvas)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from deeplearning4j_tpu.ui.stats import TYPE_ID
+from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+
+_PAGE = """<!doctype html><html><head><title>dl4j-tpu training UI</title>
+<style>body{font-family:sans-serif;margin:2em}canvas{border:1px solid #ccc}
+table{border-collapse:collapse}td,th{border:1px solid #ddd;padding:4px 8px}
+</style></head><body>
+<h2>Training overview</h2><div id="meta"></div>
+<canvas id="score" width="800" height="300"></canvas>
+<h3>Latest parameter norms</h3><table id="norms"></table>
+<script>
+async function refresh(){
+ const sids=await (await fetch('/train/sessions')).json();
+ if(!sids.length)return;
+ const sid=sids[sids.length-1];
+ const ov=await (await fetch('/train/overview?sid='+sid)).json();
+ document.getElementById('meta').textContent=
+   'session '+sid+' — '+ov.scores.length+' reports';
+ const c=document.getElementById('score').getContext('2d');
+ c.clearRect(0,0,800,300);
+ const xs=ov.iterations, ys=ov.scores;
+ if(xs.length>1){
+  const ymax=Math.max(...ys), ymin=Math.min(...ys);
+  c.beginPath();
+  xs.forEach((x,i)=>{
+   const px=40+(x-xs[0])/(xs[xs.length-1]-xs[0]||1)*740;
+   const py=280-(ys[i]-ymin)/((ymax-ymin)||1)*260;
+   i?c.lineTo(px,py):c.moveTo(px,py);});
+  c.strokeStyle='#06c';c.stroke();
+  c.fillText(ymax.toFixed(4),2,20);c.fillText(ymin.toFixed(4),2,285);
+ }
+ const t=document.getElementById('norms');
+ t.innerHTML='<tr><th>param</th><th>L2 norm</th></tr>'+
+  Object.entries(ov.latest_param_norms||{}).map(
+   ([k,v])=>'<tr><td>'+k+'</td><td>'+v.toFixed(6)+'</td></tr>').join('');
+}
+refresh();setInterval(refresh,2000);
+</script></body></html>"""
+
+
+class UIServer:
+    """Singleton-ish server (reference: UIServer.getInstance())."""
+
+    _instance = None
+
+    @classmethod
+    def get_instance(cls) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = UIServer()
+        return cls._instance
+
+    def __init__(self, port: int = 0):
+        self.storages: list = []
+        self._remote_sink = InMemoryStatsStorage()
+        self._httpd = None
+        self._thread = None
+        self._port = port
+
+    # ------------------------------------------------------------- lifecycle
+    def attach(self, storage) -> None:
+        if storage not in self.storages:
+            self.storages.append(storage)
+
+    def detach(self, storage) -> None:
+        if storage in self.storages:
+            self.storages.remove(storage)
+
+    def enable_remote_listener(self) -> None:
+        """Accept POSTed records on /remoteReceive (reference:
+        RemoteReceiverModule)."""
+        self.attach(self._remote_sink)
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd else self._port
+
+    def start(self) -> int:
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _json(self, obj, status=200):
+                body = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                u = urlparse(self.path)
+                q = parse_qs(u.query)
+                sid = q.get("sid", [None])[0]
+                if u.path == "/":
+                    body = _PAGE.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/html")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif u.path == "/train/sessions":
+                    self._json(server.list_sessions())
+                elif u.path == "/train/overview":
+                    self._json(server.overview(sid))
+                elif u.path == "/train/model":
+                    self._json(server.model_info(sid))
+                else:
+                    self._json({"error": "not found"}, 404)
+
+            def do_POST(self):
+                if urlparse(self.path).path != "/remoteReceive":
+                    self._json({"error": "not found"}, 404)
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                msg = json.loads(self.rfile.read(n))
+                sink = server._remote_sink
+                {"static": sink.put_static_info,
+                 "update": sink.put_update,
+                 "meta": sink.put_storage_metadata}[msg["kind"]](
+                     msg["record"])
+                self._json({"status": "ok"})
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self._port), Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    # ----------------------------------------------------------------- views
+    def list_sessions(self) -> list:
+        out = []
+        for s in self.storages:
+            out.extend(s.list_session_ids())
+        return sorted(set(out))
+
+    def overview(self, session_id) -> dict:
+        iters, scores, latest = [], [], None
+        for s in self.storages:
+            for r in s.get_all_updates_after(session_id, TYPE_ID):
+                iters.append(r["data"].get("iteration"))
+                scores.append(r["data"].get("score"))
+                latest = r
+        return {"iterations": iters, "scores": scores,
+                "latest_param_norms":
+                    latest["data"].get("param_norms") if latest else {},
+                "latest_update_norms":
+                    latest["data"].get("update_norms") if latest else {}}
+
+    def model_info(self, session_id) -> dict:
+        for s in self.storages:
+            r = s.get_static_info(session_id, TYPE_ID)
+            if r:
+                return r["data"]
+        return {}

@@ -1,0 +1,146 @@
+"""StatsListener: per-iteration training telemetry into a StatsStorageRouter.
+
+Reference: ui-model/.../stats/BaseStatsListener.java:44 (collection loop
+:297-381 — score, param/gradient/update norms + histograms, timings, memory,
+GC). TPU adaptation: gradients never materialise outside the jitted step, so
+update norms are computed from parameter deltas between reports (update =
+param_t - param_{t-1}, identical to the reference's updates-by-difference
+semantics for SGD-family updaters); JVM/GC memory becomes host RSS +
+device-buffer byte counts from jax.
+
+Histograms are computed on device (jnp.histogram) only at reporting
+iterations, so steady-state training stays one XLA program per step.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+from deeplearning4j_tpu.ui.storage import StatsStorageRouter, make_record
+
+TYPE_ID = "StatsListener"
+
+
+class StatsReport:
+    """Convenience view over a stored update record's data dict."""
+
+    def __init__(self, record: dict):
+        self.record = record
+        self.data = record["data"]
+
+    @property
+    def score(self):
+        return self.data["score"]
+
+    @property
+    def iteration(self):
+        return self.data["iteration"]
+
+    def param_norms(self):
+        return self.data.get("param_norms", {})
+
+    def update_norms(self):
+        return self.data.get("update_norms", {})
+
+
+def _flat_norms(params) -> dict:
+    """{'layer/param': l2norm} over a 2-level pytree."""
+    out = {}
+    for lk, lp in params.items():
+        for pk, v in lp.items():
+            out[f"{lk}/{pk}"] = float(np.linalg.norm(np.asarray(v).ravel()))
+    return out
+
+
+def _rss_bytes() -> int:
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except Exception:  # noqa: BLE001 — non-linux fallback
+        return 0
+
+
+class StatsListener(TrainingListener):
+    def __init__(self, router: StatsStorageRouter, session_id: str = None,
+                 worker_id: str = "worker_0", reporting_frequency: int = 10,
+                 collect_histograms: bool = False, histogram_bins: int = 20):
+        self.router = router
+        self.session_id = session_id or f"session_{int(time.time())}"
+        self.worker_id = worker_id
+        self.frequency = max(1, reporting_frequency)
+        self.collect_histograms = collect_histograms
+        self.histogram_bins = histogram_bins
+        self._static_sent = False
+        self._last_params_norms = None
+        self._last_time = None
+        self._last_iter = None
+
+    # ------------------------------------------------------------------ hooks
+    def on_epoch_start(self, model):
+        if not self._static_sent:
+            self._send_static(model)
+
+    def _send_static(self, model):
+        """Session/model/hardware info (reference: initializeReporting +
+        StaticInfo :~250)."""
+        conf = model.conf
+        info = {
+            "model_class": type(model).__name__,
+            "num_params": int(model.num_params()) if model.params else 0,
+            "num_layers": conf.n_layers() if hasattr(conf, "n_layers") else 0,
+            "updater": type(conf.updater).__name__,
+            "jax_backend": jax.default_backend(),
+            "devices": [str(d) for d in jax.devices()],
+            "config_json": conf.to_json(),
+        }
+        self.router.put_static_info(make_record(
+            self.session_id, TYPE_ID, self.worker_id, info))
+        self._static_sent = True
+
+    def iteration_done(self, model, iteration: int):
+        if not self._static_sent:
+            self._send_static(model)
+        if iteration % self.frequency != 0:
+            return
+        now = time.perf_counter()
+        norms = _flat_norms(model.params)
+        data = {
+            "iteration": iteration,
+            "epoch": getattr(model, "epoch", 0),
+            "score": float(model.score_value),
+            "param_norms": norms,
+            "memory_rss_bytes": _rss_bytes(),
+        }
+        if self._last_params_norms is not None:
+            # update magnitude proxy: |norm_t - norm_{t-1}| per param
+            data["update_norms"] = {
+                k: abs(norms[k] - self._last_params_norms[k])
+                for k in norms if k in self._last_params_norms}
+        if self._last_time is not None and iteration > self._last_iter:
+            dt = now - self._last_time
+            data["iterations_per_second"] = \
+                (iteration - self._last_iter) / dt if dt > 0 else None
+            data["duration_ms"] = dt * 1000.0
+        if self.collect_histograms:
+            data["param_histograms"] = self._histograms(model.params)
+        self.router.put_update(make_record(
+            self.session_id, TYPE_ID, self.worker_id, data))
+        self._last_params_norms = norms
+        self._last_time = now
+        self._last_iter = iteration
+
+    def _histograms(self, params) -> dict:
+        out = {}
+        for lk, lp in params.items():
+            for pk, v in lp.items():
+                counts, edges = np.histogram(np.asarray(v).ravel(),
+                                             bins=self.histogram_bins)
+                out[f"{lk}/{pk}"] = {"counts": counts.tolist(),
+                                     "min": float(edges[0]),
+                                     "max": float(edges[-1])}
+        return out

@@ -1,0 +1,147 @@
+"""Observability pipeline tests (ports the intent of ui-model
+TestStatsListener / TestStatsStorage and the remote-router round trip)."""
+
+import json
+import urllib.request
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Adam
+from deeplearning4j_tpu.ui import (
+    FileStatsStorage,
+    InMemoryStatsStorage,
+    RemoteUIStatsStorageRouter,
+    StatsListener,
+    UIServer,
+)
+from deeplearning4j_tpu.ui.stats import TYPE_ID
+from deeplearning4j_tpu.ui.storage import make_record
+
+
+def _trained_net_with_listener(storage, iters=25, frequency=5):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Adam(learning_rate=0.01))
+            .list(DenseLayer(n_out=8, activation="relu"),
+                  OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    listener = StatsListener(storage, session_id="s1",
+                             reporting_frequency=frequency)
+    net.set_listeners(listener)
+    rs = np.random.RandomState(0)
+    labels = rs.randint(0, 3, 32)
+    ds = DataSet((rs.randn(32, 4) + labels[:, None]).astype(np.float32),
+                 np.eye(3, dtype=np.float32)[labels])
+    for _ in range(iters):
+        net.fit(ds)
+    return net, listener
+
+
+class TestStatsListenerStorage:
+    def test_updates_recorded_and_queryable(self):
+        storage = InMemoryStatsStorage()
+        _trained_net_with_listener(storage, iters=25, frequency=5)
+        assert storage.list_session_ids() == ["s1"]
+        assert storage.list_type_ids("s1") == [TYPE_ID]
+        upd = storage.get_all_updates_after("s1", TYPE_ID)
+        assert len(upd) == 5  # iterations 5,10,15,20,25
+        d = upd[-1]["data"]
+        assert np.isfinite(d["score"])
+        assert "0/W" in d["param_norms"] and "1/b" in d["param_norms"]
+        assert d["param_norms"]["0/W"] > 0
+        assert "update_norms" in d  # from 2nd report on
+        # static info
+        info = storage.get_static_info("s1", TYPE_ID)["data"]
+        assert info["model_class"] == "MultiLayerNetwork"
+        assert info["num_params"] > 0
+        assert info["updater"] == "Adam"
+
+    def test_timestamp_filtering(self):
+        storage = InMemoryStatsStorage()
+        storage.put_update(make_record("s", "t", "w", {"x": 1},
+                                       timestamp=100.0))
+        storage.put_update(make_record("s", "t", "w", {"x": 2},
+                                       timestamp=200.0))
+        assert len(storage.get_all_updates_after("s", "t", 150.0)) == 1
+        assert storage.get_latest_update("s", "t")["data"]["x"] == 2
+
+    def test_listener_callbacks(self):
+        storage = InMemoryStatsStorage()
+        events = []
+        storage.register_stats_storage_listener(
+            lambda kind, r: events.append(kind))
+        storage.put_update(make_record("s", "t", "w", {}))
+        storage.put_static_info(make_record("s", "t", "w", {}))
+        assert events == ["update", "static"]
+
+    def test_file_storage_persistence(self, tmp_path):
+        p = str(tmp_path / "stats.jsonl")
+        s1 = FileStatsStorage(p)
+        _trained_net_with_listener(s1, iters=10, frequency=5)
+        n = s1.num_updates()
+        assert n == 2
+        s1.close()
+        s2 = FileStatsStorage(p)  # reload from disk
+        assert s2.num_updates() == n
+        assert s2.list_session_ids() == ["s1"]
+        s2.close()
+
+    def test_histograms_optional(self):
+        storage = InMemoryStatsStorage()
+        conf = (NeuralNetConfiguration.builder()
+                .seed(1).updater(Adam(learning_rate=0.01))
+                .list(DenseLayer(n_out=4, activation="relu"),
+                      OutputLayer(n_out=2, activation="softmax",
+                                  loss="mcxent"))
+                .set_input_type(InputType.feed_forward(3))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.set_listeners(StatsListener(storage, session_id="h",
+                                        reporting_frequency=1,
+                                        collect_histograms=True))
+        rs = np.random.RandomState(1)
+        net.fit(DataSet(rs.randn(8, 3).astype(np.float32),
+                        np.eye(2, dtype=np.float32)[rs.randint(0, 2, 8)]))
+        h = storage.get_latest_update("h", TYPE_ID)["data"][
+            "param_histograms"]
+        assert sum(h["0/W"]["counts"]) == 12  # 3*4 weights
+
+
+class TestUIServer:
+    def test_server_endpoints_and_remote_receive(self):
+        storage = InMemoryStatsStorage()
+        _trained_net_with_listener(storage, iters=10, frequency=5)
+        server = UIServer(port=0)
+        server.attach(storage)
+        server.enable_remote_listener()
+        port = server.start()
+        try:
+            base = f"http://127.0.0.1:{port}"
+            sessions = json.loads(
+                urllib.request.urlopen(base + "/train/sessions").read())
+            assert "s1" in sessions
+            ov = json.loads(urllib.request.urlopen(
+                base + "/train/overview?sid=s1").read())
+            assert len(ov["scores"]) == 2
+            assert ov["latest_param_norms"]
+            mi = json.loads(urllib.request.urlopen(
+                base + "/train/model?sid=s1").read())
+            assert mi["model_class"] == "MultiLayerNetwork"
+            # remote router -> server sink -> queryable
+            router = RemoteUIStatsStorageRouter(base)
+            router.put_update(make_record("remote_s", TYPE_ID, "w0",
+                                          {"iteration": 1, "score": 0.5}))
+            ov2 = json.loads(urllib.request.urlopen(
+                base + "/train/overview?sid=remote_s").read())
+            assert ov2["scores"] == [0.5]
+            # html page served
+            page = urllib.request.urlopen(base + "/").read().decode()
+            assert "Training overview" in page
+        finally:
+            server.stop()
