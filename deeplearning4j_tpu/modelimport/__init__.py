@@ -1,0 +1,10 @@
+"""Keras HDF5 model import (reference: deeplearning4j-modelimport)."""
+
+from deeplearning4j_tpu.modelimport.keras import (
+    KerasModelImport,
+    import_keras_model_and_weights,
+    import_keras_sequential_model_and_weights,
+)
+
+__all__ = ["KerasModelImport", "import_keras_model_and_weights",
+           "import_keras_sequential_model_and_weights"]

@@ -1,0 +1,369 @@
+"""Keras h5 -> native config + weights.
+
+Reference: deeplearning4j-modelimport KerasModel.java:59,73-75 (parse the
+``model_config`` JSON attribute from HDF5), :419-598 (layer-by-layer config
+translation + weight copying), layers/Keras*.java translators,
+preprocessors/TensorFlowCnnToFeedForwardPreProcessor.java (dim-ordering fix),
+Hdf5Archive.java:46 (JavaCPP HDF5 — h5py here, no JNI).
+
+Supported layer types (the reference's Keras-1 set, accepting Keras-2 config
+spellings too): Dense, Convolution2D/Conv2D, MaxPooling2D, AveragePooling2D,
+ZeroPadding2D, Flatten, Dropout, Activation, BatchNormalization, Embedding,
+LSTM, GlobalAveragePooling2D/GlobalMaxPooling2D.
+
+Layout notes (TPU-native arrays are NHWC / [B,T,F]):
+- conv kernels: tf dim-ordering h5 kernels are already HWIO — copied as-is;
+  th (channels_first) kernels [out, in, kh, kw] are transposed to HWIO and
+  flipped (Keras-1 th performs true convolution; see
+  KerasConvolution weight init in the reference).
+- Flatten after conv: our CnnToFeedForwardPreProcessor flattens NHWC; a
+  Dense trained against th-ordered flatten gets its rows permuted
+  (reference: TensorFlowCnnToFeedForwardPreProcessor).
+- LSTM gates: Keras order (i, f, c, o) -> native (i, f, o, g) block
+  permutation.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers.convolution import (
+    ConvolutionLayer,
+    SubsamplingLayer,
+    ZeroPaddingLayer,
+)
+from deeplearning4j_tpu.nn.conf.layers.core import (
+    ActivationLayer,
+    DenseLayer,
+    DropoutLayer,
+    EmbeddingLayer,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.conf.layers.normalization import BatchNormalization
+from deeplearning4j_tpu.nn.conf.layers.pooling import GlobalPoolingLayer
+from deeplearning4j_tpu.nn.conf.layers.recurrent import LSTM, RnnOutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+_KERAS_ACT = {
+    "linear": "identity", "relu": "relu", "sigmoid": "sigmoid",
+    "tanh": "tanh", "softmax": "softmax", "hard_sigmoid": "hardsigmoid",
+    "softplus": "softplus", "elu": "elu", "selu": "selu",
+    "softsign": "softsign", "swish": "swish",
+}
+
+
+def _act(name):
+    if name is None:
+        return "identity"
+    if name not in _KERAS_ACT:
+        raise ValueError(f"Unsupported Keras activation '{name}'")
+    return _KERAS_ACT[name]
+
+
+def _cfg(layer):
+    return layer.get("config", {})
+
+
+class KerasLayerTranslator:
+    """One Keras layer dict -> native layer config (reference: the
+    KerasDense/KerasConvolution/... translator classes)."""
+
+    def __init__(self, enforce_training_config: bool = False):
+        self.enforce = enforce_training_config
+
+    def translate(self, layer: dict, is_last: bool):
+        cls = layer["class_name"]
+        c = _cfg(layer)
+        if cls == "Dense":
+            n_out = c.get("output_dim") or c.get("units")
+            act = _act(c.get("activation"))
+            if is_last:
+                loss = "mcxent" if act == "softmax" else "mse"
+                return OutputLayer(n_out=n_out, activation=act, loss=loss)
+            return DenseLayer(n_out=n_out, activation=act)
+        if cls in ("Convolution2D", "Conv2D"):
+            kh = c.get("nb_row") or (c.get("kernel_size") or [3, 3])[0]
+            kw = c.get("nb_col") or (c.get("kernel_size") or [3, 3])[1]
+            n_out = c.get("nb_filter") or c.get("filters")
+            stride = tuple(c.get("subsample") or c.get("strides") or (1, 1))
+            mode = ("same" if (c.get("border_mode") or c.get("padding"))
+                    == "same" else "truncate")
+            return ConvolutionLayer(n_out=n_out, kernel_size=(kh, kw),
+                                    stride=stride, convolution_mode=mode,
+                                    activation=_act(c.get("activation")))
+        if cls in ("MaxPooling2D", "AveragePooling2D"):
+            pool = tuple(c.get("pool_size") or (2, 2))
+            stride = tuple(c.get("strides") or pool)
+            return SubsamplingLayer(
+                pooling_type="max" if cls.startswith("Max") else "avg",
+                kernel_size=pool, stride=stride,
+                convolution_mode=("same" if (c.get("border_mode")
+                                             or c.get("padding")) == "same"
+                                  else "truncate"))
+        if cls == "ZeroPadding2D":
+            p = c.get("padding") or (1, 1)
+            if isinstance(p[0], (list, tuple)):
+                (pt, pb), (pl, pr) = p
+            else:
+                pt = pb = p[0]
+                pl = pr = p[1]
+            return ZeroPaddingLayer(pad_top=pt, pad_bottom=pb, pad_left=pl,
+                                    pad_right=pr)
+        if cls == "Flatten":
+            return "flatten"  # handled via preprocessor auto-insertion
+        if cls == "Dropout":
+            return DropoutLayer(dropout=c.get("p") or c.get("rate") or 0.5)
+        if cls == "Activation":
+            return ActivationLayer(activation=_act(c.get("activation")))
+        if cls == "BatchNormalization":
+            return BatchNormalization(eps=c.get("epsilon", 1e-5),
+                                      decay=c.get("momentum", 0.9))
+        if cls == "Embedding":
+            return EmbeddingLayer(n_in=c.get("input_dim"),
+                                  n_out=c.get("output_dim"),
+                                  activation="identity")
+        if cls == "LSTM":
+            n_out = c.get("output_dim") or c.get("units")
+            act = _act(c.get("activation"))
+            gate = _act(c.get("inner_activation")
+                        or c.get("recurrent_activation") or "sigmoid")
+            return LSTM(n_out=n_out, activation=act, gate_activation=gate)
+        if cls in ("GlobalAveragePooling2D", "GlobalMaxPooling2D"):
+            return GlobalPoolingLayer(
+                pooling_type="avg" if "Average" in cls else "max")
+        if cls == "InputLayer":
+            return None
+        raise ValueError(f"Unsupported Keras layer type '{cls}'")
+
+    def input_type(self, layer: dict, dim_ordering: str):
+        """InputType from the first layer's batch_input_shape."""
+        c = _cfg(layer)
+        shape = c.get("batch_input_shape") or c.get("batch_shape")
+        if shape is None:
+            return None
+        shape = [s for s in shape[1:]]  # drop batch dim
+        if len(shape) == 3:
+            if dim_ordering == "th":
+                ch, h, w = shape
+            else:
+                h, w, ch = shape
+            return InputType.convolutional(h, w, ch)
+        if len(shape) == 2:
+            return InputType.recurrent(shape[1], shape[0])
+        if len(shape) == 1:
+            return InputType.feed_forward(shape[0])
+        return None
+
+
+class KerasModelImport:
+    """reference: KerasModelImport.java entry points."""
+
+    @staticmethod
+    def import_keras_sequential_model_and_weights(path: str
+                                                  ) -> MultiLayerNetwork:
+        return import_keras_sequential_model_and_weights(path)
+
+    @staticmethod
+    def import_keras_model_and_weights(path: str):
+        return import_keras_model_and_weights(path)
+
+
+def _model_config(f) -> dict:
+    raw = f.attrs.get("model_config")
+    if raw is None:
+        raise ValueError("No 'model_config' attribute in HDF5 file "
+                         "(weights-only files are not importable as models)")
+    if isinstance(raw, bytes):
+        raw = raw.decode("utf-8")
+    return json.loads(raw)
+
+
+def import_keras_model_and_weights(path: str):
+    """Functional or Sequential model import (linear Functional graphs are
+    imported as sequential stacks; reference: KerasModelImport
+    .importKerasModelAndWeights)."""
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        config = _model_config(f)
+    if config["class_name"] == "Sequential":
+        return import_keras_sequential_model_and_weights(path)
+    layers = config["config"]["layers"] \
+        if isinstance(config["config"], dict) else config["config"]
+    # accept linear chains only (single input, each layer feeds the next)
+    seq_layers = [l for l in layers if l["class_name"] != "InputLayer"]
+    fake = {"class_name": "Sequential", "config": seq_layers}
+    return _import_sequential(path, fake)
+
+
+def import_keras_sequential_model_and_weights(path: str) -> MultiLayerNetwork:
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        config = _model_config(f)
+    if config["class_name"] != "Sequential":
+        raise ValueError("Not a Sequential model; use "
+                         "import_keras_model_and_weights")
+    return _import_sequential(path, config)
+
+
+def _import_sequential(path: str, config: dict) -> MultiLayerNetwork:
+    import h5py
+
+    layer_dicts = config["config"]
+    if isinstance(layer_dicts, dict):  # Keras 2 nests under "layers"
+        layer_dicts = layer_dicts["layers"]
+    translator = KerasLayerTranslator()
+
+    dim_ordering = "tf"
+    for ld in layer_dicts:
+        d = _cfg(ld).get("dim_ordering") or _cfg(ld).get("data_format")
+        if d:
+            dim_ordering = {"channels_first": "th",
+                            "channels_last": "tf"}.get(d, d)
+            break
+
+    native_layers = []
+    keras_names = []  # keras layer name per native layer (for weights)
+    input_type = None
+    n_real = sum(1 for l in layer_dicts
+                 if l["class_name"] not in ("InputLayer", "Flatten"))
+    seen_real = 0
+    for i, ld in enumerate(layer_dicts):
+        if input_type is None:
+            it = translator.input_type(ld, dim_ordering)
+            if it is not None:
+                input_type = it
+        t = translator.translate(
+            ld, is_last=(seen_real + 1 == n_real
+                         and ld["class_name"] not in ("InputLayer",
+                                                      "Flatten")))
+        if t is None or t == "flatten":
+            continue
+        seen_real += 1
+        native_layers.append(t)
+        keras_names.append(_cfg(ld).get("name") or ld.get("name")
+                           or f"layer_{i}")
+
+    if input_type is None:
+        raise ValueError("Could not infer input shape "
+                         "(no batch_input_shape in first layer)")
+    conf = (NeuralNetConfiguration.builder()
+            .seed(12345)
+            .list(*native_layers)
+            .set_input_type(input_type)
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    _copy_weights(path, net, keras_names, dim_ordering)
+    return net
+
+
+def _weight_arrays(f, keras_name: str):
+    """Ordered weight arrays for one keras layer from the model_weights
+    group (reference: KerasModel weight loading via 'weight_names' attr)."""
+    root = f["model_weights"] if "model_weights" in f else f
+    if keras_name not in root:
+        return []
+    g = root[keras_name]
+    names = g.attrs.get("weight_names")
+    out = []
+    if names is not None:
+        for n in names:
+            n = n.decode() if isinstance(n, bytes) else str(n)
+            out.append(np.asarray(g[n]))
+    else:
+        def visit(_, obj):
+            import h5py as _h
+            if isinstance(obj, _h.Dataset):
+                out.append(np.asarray(obj))
+        g.visititems(visit)
+    return out
+
+
+def _copy_weights(path, net, keras_names, dim_ordering):
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        for i, (layer, kname) in enumerate(zip(net.conf.layers, keras_names)):
+            ws = _weight_arrays(f, kname)
+            if not ws:
+                continue
+            key = str(i)
+            p = dict(net.params[key])
+            if isinstance(layer, ConvolutionLayer):
+                k, b = ws[0], (ws[1] if len(ws) > 1 else None)
+                if k.ndim == 4 and dim_ordering == "th":
+                    # [out, in, kh, kw] true-conv -> HWIO cross-correlation
+                    k = np.transpose(k, (2, 3, 1, 0))[::-1, ::-1]
+                p["W"] = jnp.asarray(np.ascontiguousarray(k),
+                                     p["W"].dtype)
+                if b is not None:
+                    p["b"] = jnp.asarray(b, p["b"].dtype)
+            elif isinstance(layer, (DenseLayer, OutputLayer)):
+                W, b = ws[0], (ws[1] if len(ws) > 1 else None)
+                if W.shape != tuple(p["W"].shape):
+                    raise ValueError(
+                        f"Dense weight shape {W.shape} != expected "
+                        f"{tuple(p['W'].shape)} for layer {i}")
+                pre = net.conf.preprocessors.get(i)
+                if (dim_ordering == "th" and pre is not None
+                        and hasattr(pre, "channels")):
+                    # keras th Flatten emitted (c,h,w) order; our flatten is
+                    # NHWC -> permute rows (reference:
+                    # TensorFlowCnnToFeedForwardPreProcessor inverse)
+                    h_, w_, c_ = pre.height, pre.width, pre.channels
+                    perm = np.arange(c_ * h_ * w_).reshape(
+                        c_, h_, w_).transpose(1, 2, 0).ravel()
+                    W = W[perm]
+                p["W"] = jnp.asarray(W, p["W"].dtype)
+                if b is not None:
+                    p["b"] = jnp.asarray(b, p["b"].dtype)
+            elif isinstance(layer, BatchNormalization):
+                # keras order: gamma, beta, moving_mean, moving_var
+                names = ["gamma", "beta"]
+                for name, w in zip(names, ws[:2]):
+                    if name in p:
+                        p[name] = jnp.asarray(w, p[name].dtype)
+                st = dict(net.state.get(key, {}))
+                if len(ws) >= 4:
+                    st["mean"] = jnp.asarray(ws[2])
+                    st["var"] = jnp.asarray(ws[3])
+                    net.state[key] = st
+            elif isinstance(layer, LSTM):
+                p.update(_lstm_weights(ws, layer, p))
+            elif isinstance(layer, EmbeddingLayer):
+                p["W"] = jnp.asarray(ws[0], p["W"].dtype)
+            net.params[key] = p
+
+
+def _lstm_weights(ws, layer, p):
+    """Keras LSTM weights -> native {W, RW, b} with (i,f,c,o)->(i,f,o,g)
+    block permutation. Handles Keras-2 packed (kernel, recurrent, bias) and
+    Keras-1 per-gate 12-array layouts."""
+    H = layer.n_out
+
+    def permute(m, axis):
+        blocks = np.split(m, 4, axis=axis)  # i, f, c, o
+        return np.concatenate([blocks[0], blocks[1], blocks[3], blocks[2]],
+                              axis=axis)
+
+    if len(ws) == 3:
+        W, RW, b = ws
+        return {"W": jnp.asarray(permute(W, 1), p["W"].dtype),
+                "RW": jnp.asarray(permute(RW, 1), p["RW"].dtype),
+                "b": jnp.asarray(permute(b, 0), p["b"].dtype)}
+    if len(ws) == 12:
+        # keras1 order: W_i U_i b_i, W_c U_c b_c, W_f U_f b_f, W_o U_o b_o
+        Wi, Ui, bi, Wc, Uc, bc, Wf, Uf, bf, Wo, Uo, bo = ws
+        W = np.concatenate([Wi, Wf, Wo, Wc], axis=1)
+        RW = np.concatenate([Ui, Uf, Uo, Uc], axis=1)
+        b = np.concatenate([bi, bf, bo, bc], axis=0)
+        return {"W": jnp.asarray(W, p["W"].dtype),
+                "RW": jnp.asarray(RW, p["RW"].dtype),
+                "b": jnp.asarray(b, p["b"].dtype)}
+    raise ValueError(f"Unexpected LSTM weight count {len(ws)}")
