@@ -1,0 +1,8 @@
+"""Clustering + spatial search (reference: deeplearning4j-core clustering/ —
+kmeans/, kdtree/, vptree/VPTree.java:39)."""
+
+from deeplearning4j_tpu.clustering.kmeans import KMeansClustering
+from deeplearning4j_tpu.clustering.kdtree import KDTree
+from deeplearning4j_tpu.clustering.vptree import VPTree
+
+__all__ = ["KMeansClustering", "KDTree", "VPTree"]

@@ -1,0 +1,13 @@
+"""Graph embeddings (reference: deeplearning4j-graph — IGraph API,
+random-walk iterators, DeepWalk + GraphHuffman)."""
+
+from deeplearning4j_tpu.graph.graph import Graph
+from deeplearning4j_tpu.graph.walks import (
+    Node2VecWalkIterator,
+    RandomWalkIterator,
+    WeightedRandomWalkIterator,
+)
+from deeplearning4j_tpu.graph.deepwalk import DeepWalk, Node2Vec
+
+__all__ = ["Graph", "RandomWalkIterator", "WeightedRandomWalkIterator",
+           "Node2VecWalkIterator", "DeepWalk", "Node2Vec"]

@@ -1,0 +1,5 @@
+"""k-NN REST server (reference: deeplearning4j-nearestneighbor-server)."""
+
+from deeplearning4j_tpu.nearestneighbors.server import NearestNeighborsServer
+
+__all__ = ["NearestNeighborsServer"]

@@ -1,0 +1,89 @@
+"""REST k-NN service over a VPTree.
+
+Reference: deeplearning4j-nearestneighbor-server
+(server/NearestNeighborsServer.java + NearestNeighbor.java — Play REST,
+base64 NDArray payloads). Here: stdlib http.server + JSON vectors (no
+base64-NDArray legacy), same endpoints in spirit:
+
+- POST /knn        {"k": 3, "point": [..]}          -> single query
+- POST /knnVector  {"k": 3, "points": [[..], ..]}   -> batched (device path)
+- GET  /status     -> {"points": N, "dims": D}
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from deeplearning4j_tpu.clustering.vptree import VPTree
+
+
+class NearestNeighborsServer:
+    def __init__(self, points, port: int = 0, metric: str = "euclidean"):
+        self.points = np.asarray(points, np.float64)
+        self.tree = VPTree(self.points, metric=metric)
+        self._port = port
+        self._httpd = None
+        self._thread = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd else self._port
+
+    def start(self) -> int:
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, obj, status=200):
+                body = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/status":
+                    self._json({"points": int(server.points.shape[0]),
+                                "dims": int(server.points.shape[1])})
+                else:
+                    self._json({"error": "not found"}, 404)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    req = json.loads(self.rfile.read(n))
+                except json.JSONDecodeError:
+                    self._json({"error": "bad json"}, 400)
+                    return
+                k = int(req.get("k", 1))
+                if self.path == "/knn":
+                    res = server.tree.search(np.asarray(req["point"]), k)
+                    self._json({"results": [
+                        {"index": i, "distance": d} for d, i in res]})
+                elif self.path == "/knnVector":
+                    batches = server.tree.search_batch(
+                        np.asarray(req["points"]), k)
+                    self._json({"results": [
+                        [{"index": i, "distance": d} for d, i in b]
+                        for b in batches]})
+                else:
+                    self._json({"error": "not found"}, 404)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self._port), Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -112,7 +112,9 @@ class VocabConstructor:
     def build_vocab(self, sentences) -> AbstractCache:
         cache = AbstractCache()
         for sentence in sentences:
-            for tok in self.tokenizer_factory.create(sentence).tokens():
+            toks = (list(sentence) if isinstance(sentence, (list, tuple))
+                    else self.tokenizer_factory.create(sentence).tokens())
+            for tok in toks:
                 cache.add_token(VocabWord(tok, 1.0))
         cache.remove_below(self.min_word_frequency)
         cache.update_indices()

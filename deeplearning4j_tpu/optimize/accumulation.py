@@ -1,0 +1,109 @@
+"""Gradient sharing with threshold-encoding compression.
+
+Reference: optimize/solvers/accumulation/ — GradientsAccumulator SPI hooked
+into the SGD step (StochasticGradientDescent.java:74), EncodingHandler.java:65
+(``Nd4j.getExecutioner().thresholdEncode``: entries with |g| >= threshold are
+quantised to sign(g)*threshold, the remainder stays in a residual buffer) and
+:91 (broadcast of the sparse message).
+
+TPU-native placement: over ICI, gradient reduction is a plain ``lax.psum``
+inside the jitted step (bandwidth-rich — compression would cost more than it
+saves; see parallel/trainer.py). This module provides the compression path
+for bandwidth-POOR links (DCN / multi-pod, the reference's original setting):
+jitted encode/decode + a residual-carrying accumulator whose quantised
+all-reduce provably converges (error-feedback SGD).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def threshold_encode(grad, residual, threshold):
+    """-> (quantised message, new residual).
+
+    message = sign(g) * threshold where |g| >= threshold else 0, computed on
+    g = grad + residual; new residual = g - message (error feedback). The
+    dense message is exactly what the reference's sparse IntArray encodes —
+    index/sign extraction is a transport detail (see ``sparsify``)."""
+    g = grad + residual
+    mask = jnp.abs(g) >= threshold
+    msg = jnp.where(mask, jnp.sign(g) * threshold, 0.0)
+    return msg, g - msg
+
+
+def sparsify(message: np.ndarray, threshold: float):
+    """Dense quantised message -> (int32 index array, sign bits) wire form
+    (reference: the ND4J threshold-encoded IntArray layout in spirit)."""
+    message = np.asarray(message).ravel()
+    idx = np.nonzero(message)[0].astype(np.int32)
+    signs = (message[idx] > 0)
+    return idx, signs
+
+
+def unsparsify(idx, signs, threshold: float, size: int) -> np.ndarray:
+    out = np.zeros(size, np.float32)
+    out[idx] = np.where(signs, threshold, -threshold)
+    return out
+
+
+class EncodingHandler:
+    """Residual-carrying encoder for one worker (reference:
+    EncodingHandler.java:65 — initialThreshold, with the adaptive shrink/grow
+    of later reference versions omitted: fixed threshold, as at this
+    vintage)."""
+
+    def __init__(self, threshold: float = 1e-3):
+        self.threshold = threshold
+        self._residual = None
+
+    def encode(self, flat_grad):
+        g = jnp.asarray(flat_grad)
+        if self._residual is None:
+            self._residual = jnp.zeros_like(g)
+        msg, self._residual = threshold_encode(g, self._residual,
+                                               jnp.float32(self.threshold))
+        return msg
+
+    def residual_norm(self) -> float:
+        return 0.0 if self._residual is None else \
+            float(jnp.linalg.norm(self._residual))
+
+
+class BasicGradientsAccumulator:
+    """Multi-worker accumulator (reference: BasicGradientsAccumulator /
+    LocalHandler): each worker stores (encoded) updates; ``get_update``
+    returns the aggregated update for application. Synchronous semantics —
+    the async Aeron transport is replaced by whatever carries the numpy
+    arrays between hosts."""
+
+    def __init__(self, workers: int, threshold: float = 1e-3,
+                 compress: bool = True):
+        self.workers = workers
+        self.compress = compress
+        self._handlers = [EncodingHandler(threshold)
+                          for _ in range(workers)]
+        self._pending: list = []
+
+    def store_update(self, worker: int, flat_grad) -> None:
+        if self.compress:
+            msg = self._handlers[worker].encode(flat_grad)
+        else:
+            msg = jnp.asarray(flat_grad)
+        self._pending.append(msg)
+
+    def get_update(self):
+        """Mean of stored updates; clears the round."""
+        if not self._pending:
+            return None
+        out = self._pending[0]
+        for m in self._pending[1:]:
+            out = out + m
+        out = out / float(len(self._pending))
+        self._pending = []
+        return out

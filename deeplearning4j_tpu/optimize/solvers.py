@@ -1,0 +1,259 @@
+"""Batch optimizers beyond SGD: line search, CG, L-BFGS.
+
+Reference: optimize/Solver.java (facade), optimize/solvers/BaseOptimizer.java
+:170-247 (gradientAndScore + line-search step), StochasticGradientDescent.java
+:60-80, LineGradientDescent.java, ConjugateGradient.java, LBFGS.java,
+BackTrackLineSearch.java, optimize/stepfunctions/*, optimize/terminations/*.
+
+TPU-native: the model's loss is a pure function of the flat parameter vector
+(ravel_pytree); value-and-gradient is one jitted program, and each optimizer
+is host-side control flow over device-resident vectors — the natural split
+(line-search trial counts are data-dependent, so they stay out of XLA).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.flatten_util import ravel_pytree
+
+
+# ----------------------------------------------------------------- terminations
+class TerminationCondition:
+    """reference: optimize/terminations/"""
+
+    def terminate(self, cost: float, old_cost: float, other=None) -> bool:
+        raise NotImplementedError
+
+
+class EpsTermination(TerminationCondition):
+    """|old - new| <= eps * (|old| + |new| + eps) (reference:
+    EpsTermination.java)."""
+
+    def __init__(self, eps: float = 1e-10, tolerance: float = 1e-5):
+        self.eps = eps
+        self.tolerance = tolerance
+
+    def terminate(self, cost, old_cost, other=None):
+        return 2.0 * abs(old_cost - cost) <= self.tolerance * (
+            abs(old_cost) + abs(cost) + self.eps)
+
+
+class Norm2Termination(TerminationCondition):
+    """Gradient L2 below threshold (reference: Norm2Termination.java)."""
+
+    def __init__(self, gradient_norm_threshold: float = 1e-8):
+        self.threshold = gradient_norm_threshold
+
+    def terminate(self, cost, old_cost, other=None):
+        return other is not None and float(other) < self.threshold
+
+
+class ZeroDirection(TerminationCondition):
+    def terminate(self, cost, old_cost, other=None):
+        return other is not None and float(other) == 0.0
+
+
+# ------------------------------------------------------------------ line search
+class BackTrackLineSearch:
+    """Backtracking line search with Armijo sufficient-decrease (reference:
+    optimize/solvers/BackTrackLineSearch.java — relax constant ALF=1e-4,
+    step contraction, maxIterations)."""
+
+    def __init__(self, loss_fn: Callable, max_iterations: int = 5,
+                 step_max: float = 100.0, alf: float = 1e-4,
+                 contraction: float = 0.5):
+        self.loss_fn = loss_fn
+        self.max_iterations = max_iterations
+        self.step_max = step_max
+        self.alf = alf
+        self.contraction = contraction
+
+    def optimize(self, x, f0: float, g, direction, initial_step: float = 1.0
+                 ) -> float:
+        """Returns the accepted step size along ``direction`` from ``x``."""
+        slope = float(jnp.vdot(g, direction))
+        if slope >= 0:
+            return 0.0
+        dnorm = float(jnp.linalg.norm(direction))
+        step = min(initial_step, self.step_max / max(dnorm, 1e-12))
+        for _ in range(self.max_iterations):
+            f_new = float(self.loss_fn(x + step * direction))
+            if f_new <= f0 + self.alf * step * slope:
+                return step
+            step *= self.contraction
+        return step
+
+
+# ------------------------------------------------------------------- optimizers
+class BaseOptimizer:
+    """Shared machinery (reference: BaseOptimizer.java): a jitted
+    value-and-grad over the flat view + termination checks."""
+
+    def __init__(self, max_iterations: int = 10,
+                 terminations: Optional[list] = None,
+                 line_search_iterations: int = 5):
+        self.max_iterations = max_iterations
+        self.terminations = terminations or [EpsTermination()]
+        self.line_search_iterations = line_search_iterations
+
+    def _setup(self, net, x, y):
+        params0 = net.params
+        flat0, unravel = ravel_pytree(params0)
+        xj, yj = jnp.asarray(x), jnp.asarray(y)
+
+        @jax.jit
+        def value(flat):
+            loss, _ = net._loss(unravel(flat), net.state, xj, yj, None, None,
+                                train=False, rng=None)
+            return loss
+
+        vg = jax.jit(jax.value_and_grad(value))
+        return flat0, unravel, value, vg
+
+    def optimize(self, net, x, y) -> float:
+        raise NotImplementedError
+
+    def _finish(self, net, flat, unravel, loss):
+        net.params = unravel(flat)
+        net.score_value = float(loss)
+        return float(loss)
+
+
+class LineGradientDescent(BaseOptimizer):
+    """Steepest descent + line search (reference: LineGradientDescent.java)."""
+
+    def optimize(self, net, x, y) -> float:
+        flat, unravel, value, vg = self._setup(net, x, y)
+        loss, g = vg(flat)
+        old = float("inf")
+        for _ in range(self.max_iterations):
+            direction = -g
+            ls = BackTrackLineSearch(value, self.line_search_iterations)
+            step = ls.optimize(flat, float(loss), g, direction)
+            if step == 0.0:
+                break
+            flat = flat + step * direction
+            old, loss = float(loss), None
+            loss, g = vg(flat)
+            if any(t.terminate(float(loss), old, jnp.linalg.norm(g))
+                   for t in self.terminations):
+                break
+        return self._finish(net, flat, unravel, loss)
+
+
+class ConjugateGradient(BaseOptimizer):
+    """Nonlinear CG, Polak-Ribiere with automatic restart (reference:
+    ConjugateGradient.java)."""
+
+    def optimize(self, net, x, y) -> float:
+        flat, unravel, value, vg = self._setup(net, x, y)
+        loss, g = vg(flat)
+        direction = -g
+        old = float("inf")
+        for _ in range(self.max_iterations):
+            ls = BackTrackLineSearch(value, self.line_search_iterations)
+            step = ls.optimize(flat, float(loss), g, direction)
+            if step == 0.0:
+                direction = -g  # restart
+                step = ls.optimize(flat, float(loss), g, direction)
+                if step == 0.0:
+                    break
+            flat = flat + step * direction
+            old = float(loss)
+            loss, g_new = vg(flat)
+            # Polak-Ribiere beta, clamped at 0 (auto-restart)
+            beta = float(jnp.vdot(g_new, g_new - g)
+                         / jnp.maximum(jnp.vdot(g, g), 1e-12))
+            beta = max(0.0, beta)
+            direction = -g_new + beta * direction
+            g = g_new
+            if any(t.terminate(float(loss), old, jnp.linalg.norm(g))
+                   for t in self.terminations):
+                break
+        return self._finish(net, flat, unravel, loss)
+
+
+class LBFGS(BaseOptimizer):
+    """Limited-memory BFGS, two-loop recursion (reference: LBFGS.java,
+    default memory m=4... the reference uses 4; configurable here)."""
+
+    def __init__(self, max_iterations: int = 10, memory: int = 10, **kw):
+        super().__init__(max_iterations=max_iterations, **kw)
+        self.memory = memory
+
+    def optimize(self, net, x, y) -> float:
+        flat, unravel, value, vg = self._setup(net, x, y)
+        loss, g = vg(flat)
+        s_hist: list = []
+        y_hist: list = []
+        old = float("inf")
+        for _ in range(self.max_iterations):
+            # two-loop recursion
+            q = g
+            alphas = []
+            for s, yv in zip(reversed(s_hist), reversed(y_hist)):
+                rho = 1.0 / float(jnp.maximum(jnp.vdot(yv, s), 1e-12))
+                a = rho * float(jnp.vdot(s, q))
+                alphas.append((a, rho, s, yv))
+                q = q - a * yv
+            if y_hist:
+                gamma = float(jnp.vdot(s_hist[-1], y_hist[-1])
+                              / jnp.maximum(jnp.vdot(y_hist[-1],
+                                                     y_hist[-1]), 1e-12))
+                q = gamma * q
+            for a, rho, s, yv in reversed(alphas):
+                b = rho * float(jnp.vdot(yv, q))
+                q = q + (a - b) * s
+            direction = -q
+            ls = BackTrackLineSearch(value, self.line_search_iterations)
+            step = ls.optimize(flat, float(loss), g, direction)
+            if step == 0.0:
+                break
+            new_flat = flat + step * direction
+            old = float(loss)
+            new_loss, new_g = vg(new_flat)
+            s_hist.append(new_flat - flat)
+            y_hist.append(new_g - g)
+            if len(s_hist) > self.memory:
+                s_hist.pop(0)
+                y_hist.pop(0)
+            flat, loss, g = new_flat, new_loss, new_g
+            if any(t.terminate(float(loss), old, jnp.linalg.norm(g))
+                   for t in self.terminations):
+                break
+        return self._finish(net, flat, unravel, loss)
+
+
+_SOLVERS = {
+    "line_gradient_descent": LineGradientDescent,
+    "conjugate_gradient": ConjugateGradient,
+    "lbfgs": LBFGS,
+}
+
+
+class Solver:
+    """Facade choosing the optimizer from the configured algorithm
+    (reference: optimize/Solver.java builder)."""
+
+    def __init__(self, net, algorithm: Optional[str] = None,
+                 max_iterations: int = 10, **kw):
+        algo = (algorithm or getattr(net.conf, "optimization_algo",
+                                     "stochastic_gradient_descent")).lower()
+        if algo == "stochastic_gradient_descent":
+            self.optimizer = None  # handled by the jitted fit path
+        elif algo in _SOLVERS:
+            self.optimizer = _SOLVERS[algo](max_iterations=max_iterations,
+                                            **kw)
+        else:
+            raise ValueError(f"Unknown optimization algorithm '{algo}'")
+        self.net = net
+
+    def optimize(self, x, y) -> float:
+        if self.optimizer is None:
+            loss, _ = self.net.do_step(x, y)
+            return loss
+        return self.optimizer.optimize(self.net, x, y)

@@ -1,0 +1,8 @@
+"""Dimensionality-reduction plotting tools (reference: deeplearning4j-core
+plot/ — BarnesHutTsne.java:65)."""
+
+from deeplearning4j_tpu.plot.tsne import Tsne
+
+BarnesHutTsne = Tsne  # reference-name alias
+
+__all__ = ["Tsne", "BarnesHutTsne"]

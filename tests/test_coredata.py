@@ -1,0 +1,268 @@
+"""Core-data layer tests: datavec bridge, CIFAR/LFW, clustering, VPTree,
+t-SNE, k-NN server, graph embeddings (ports the intent of
+deeplearning4j-core's RecordReaderDataSetIteratorTest, KMeansTest,
+VPTreeTest, Test*Tsne, and deeplearning4j-graph's DeepWalk tests)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import KDTree, KMeansClustering, VPTree
+from deeplearning4j_tpu.datavec import (
+    CollectionRecordReader,
+    CollectionSequenceRecordReader,
+    CSVRecordReader,
+    RecordReaderDataSetIterator,
+    RecordReaderMultiDataSetIterator,
+    SequenceRecordReaderDataSetIterator,
+)
+from deeplearning4j_tpu.graph import DeepWalk, Graph, Node2Vec
+from deeplearning4j_tpu.nearestneighbors import NearestNeighborsServer
+from deeplearning4j_tpu.plot import Tsne
+
+
+class TestRecordReaders:
+    def test_csv_reader_and_classification_iterator(self, tmp_path):
+        p = tmp_path / "data.csv"
+        p.write_text("h1,h2,h3\n1.0,2.0,0\n3.0,4.0,1\n5.0,6.0,2\n7,8,0\n")
+        rr = CSVRecordReader(str(p), skip_lines=1)
+        it = RecordReaderDataSetIterator(rr, batch_size=2, label_index=2,
+                                         num_classes=3)
+        batches = list(it)
+        assert len(batches) == 2
+        assert batches[0].features.shape == (2, 2)
+        assert batches[0].labels.shape == (2, 3)
+        assert np.allclose(batches[0].features[0], [1.0, 2.0])
+        assert batches[0].labels[1].argmax() == 1
+
+    def test_regression_iterator(self):
+        rr = CollectionRecordReader([[1.0, 2.0, 0.5], [3.0, 4.0, 1.5]])
+        it = RecordReaderDataSetIterator(rr, batch_size=2, label_index=2,
+                                         regression=True)
+        ds = next(iter(it))
+        assert ds.labels.shape == (2, 1)
+        assert np.allclose(ds.labels[:, 0], [0.5, 1.5])
+
+    def test_sequence_iterator_padding_and_masks(self):
+        seqs = [
+            [[1.0, 0], [2.0, 1], [3.0, 0]],   # len 3
+            [[4.0, 1], [5.0, 0]],              # len 2 -> padded
+        ]
+        rr = CollectionSequenceRecordReader(seqs)
+        it = SequenceRecordReaderDataSetIterator(rr, batch_size=2,
+                                                 label_index=1,
+                                                 num_classes=2)
+        ds = next(iter(it))
+        assert ds.features.shape == (2, 3, 1)
+        assert ds.labels.shape == (2, 3, 2)
+        assert np.allclose(ds.features_mask, [[1, 1, 1], [1, 1, 0]])
+        assert np.allclose(ds.labels[1, 2], [0, 0])  # masked step zeroed
+
+    def test_multi_dataset_iterator(self):
+        r1 = CollectionRecordReader([[1, 2, 0], [3, 4, 1], [5, 6, 2],
+                                     [7, 8, 0]])
+        it = (RecordReaderMultiDataSetIterator(batch_size=2)
+              .add_reader("r", r1)
+              .add_input("r", 0, 1)
+              .add_output_one_hot("r", 2, 3))
+        mds = list(it)
+        assert len(mds) == 2
+        assert mds[0].features[0].shape == (2, 2)
+        assert mds[0].labels[0].shape == (2, 3)
+
+
+class TestBuiltinDatasets:
+    def test_cifar_synthetic_trains(self):
+        from deeplearning4j_tpu.datasets.cifar import CifarDataSetIterator
+        from deeplearning4j_tpu.nn.conf.builders import \
+            NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers.convolution import (
+            ConvolutionLayer,
+            SubsamplingLayer,
+        )
+        from deeplearning4j_tpu.nn.conf.layers.core import (
+            DenseLayer,
+            OutputLayer,
+        )
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.updater import Adam
+
+        it = CifarDataSetIterator(batch_size=64, num_examples=256)
+        assert it.synthetic
+        ds0 = next(iter(it))
+        assert ds0.features.shape == (64, 32, 32, 3)
+        assert ds0.features.min() >= 0 and ds0.features.max() <= 1
+        conf = (NeuralNetConfiguration.builder()
+                .seed(1).updater(Adam(learning_rate=1e-3))
+                .list(ConvolutionLayer(n_out=8, kernel_size=(5, 5),
+                                       convolution_mode="same",
+                                       activation="relu"),
+                      SubsamplingLayer(kernel_size=(4, 4), stride=(4, 4)),
+                      DenseLayer(n_out=32, activation="relu"),
+                      OutputLayer(n_out=10, activation="softmax",
+                                  loss="mcxent"))
+                .set_input_type(InputType.convolutional(32, 32, 3))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        losses = []
+        for _ in range(8):
+            it.reset()
+            ep = [net.do_step(ds.features, ds.labels)[0] for ds in it]
+            losses.append(float(np.mean(ep)))
+        assert losses[-1] < losses[0] * 0.7, losses
+
+    def test_lfw_synthetic_shapes(self):
+        from deeplearning4j_tpu.datasets.cifar import LFWDataSetIterator
+
+        it = LFWDataSetIterator(batch_size=16, num_examples=64,
+                                image_size=32, num_people=5)
+        ds = next(iter(it))
+        assert ds.features.shape == (16, 32, 32, 3)
+        assert ds.labels.shape == (16, 5)
+
+
+class TestClustering:
+    def test_kmeans_recovers_blobs(self):
+        rs = np.random.RandomState(0)
+        centers = np.array([[0, 0], [10, 0], [0, 10]], np.float32)
+        x = np.concatenate([c + rs.randn(50, 2).astype(np.float32)
+                            for c in centers])
+        km = KMeansClustering(k=3, max_iterations=50, seed=1)
+        assign = km.apply_to(x)
+        # each true blob maps to one dominant cluster
+        for blob in range(3):
+            counts = np.bincount(assign[blob * 50:(blob + 1) * 50],
+                                 minlength=3)
+            assert counts.max() >= 45
+        # predicted centers near true centers
+        d = np.linalg.norm(km.centers[:, None, :] - centers[None], axis=2)
+        assert d.min(axis=0).max() < 1.0
+
+    def test_kdtree_knn_matches_bruteforce(self):
+        rs = np.random.RandomState(1)
+        pts = rs.randn(200, 3)
+        tree = KDTree.build(pts)
+        q = rs.randn(3)
+        res = tree.knn(q, 5)
+        brute = np.argsort(np.linalg.norm(pts - q, axis=1))[:5]
+        assert [i for _, i in res] == list(brute)
+        d, i = tree.nn(q)
+        assert i == brute[0]
+
+    def test_kdtree_insert_and_range(self):
+        tree = KDTree(2)
+        for i, p in enumerate([[0, 0], [1, 1], [2, 2], [5, 5]]):
+            tree.insert(p, i)
+        inside = tree.range([0.5, 0.5], [2.5, 2.5])
+        assert sorted(inside) == [1, 2]
+
+    def test_vptree_matches_bruteforce(self):
+        rs = np.random.RandomState(2)
+        pts = rs.randn(300, 4)
+        tree = VPTree(pts)
+        q = rs.randn(4)
+        res = tree.search(q, 7)
+        brute = np.argsort(np.linalg.norm(pts - q, axis=1))[:7]
+        assert [i for _, i in res] == list(brute)
+
+    def test_vptree_batch_device_path(self):
+        rs = np.random.RandomState(3)
+        pts = rs.randn(100, 4)
+        tree = VPTree(pts)
+        qs = rs.randn(5, 4)
+        batch = tree.search_batch(qs, 3)
+        assert len(batch) == 5
+        for qi, results in enumerate(batch):
+            brute = np.argsort(np.linalg.norm(pts - qs[qi], axis=1))[:3]
+            assert [i for _, i in results] == list(brute)
+
+
+class TestTsne:
+    def test_tsne_separates_clusters(self):
+        rs = np.random.RandomState(4)
+        a = rs.randn(30, 10) * 0.3
+        b = rs.randn(30, 10) * 0.3 + 5.0
+        x = np.concatenate([a, b])
+        tsne = Tsne(num_dimension=2, perplexity=10, max_iter=250,
+                    learning_rate=100.0, seed=0)
+        y = tsne.fit(x)
+        assert y.shape == (60, 2)
+        assert np.isfinite(tsne.kl)
+        # cluster separation in the embedding: inter > intra distances
+        ca, cb = y[:30].mean(0), y[30:].mean(0)
+        intra = max(np.linalg.norm(y[:30] - ca, axis=1).mean(),
+                    np.linalg.norm(y[30:] - cb, axis=1).mean())
+        assert np.linalg.norm(ca - cb) > 2 * intra
+
+
+class TestKnnServer:
+    def test_server_endpoints(self):
+        rs = np.random.RandomState(5)
+        pts = rs.randn(50, 3)
+        server = NearestNeighborsServer(pts, port=0)
+        port = server.start()
+        try:
+            base = f"http://127.0.0.1:{port}"
+            st = json.loads(urllib.request.urlopen(base + "/status").read())
+            assert st == {"points": 50, "dims": 3}
+            q = pts[7] + 0.001
+            req = urllib.request.Request(
+                base + "/knn",
+                data=json.dumps({"k": 2, "point": q.tolist()}).encode(),
+                headers={"Content-Type": "application/json"})
+            res = json.loads(urllib.request.urlopen(req).read())["results"]
+            assert res[0]["index"] == 7
+            req = urllib.request.Request(
+                base + "/knnVector",
+                data=json.dumps({"k": 1,
+                                 "points": [pts[3].tolist(),
+                                            pts[9].tolist()]}).encode(),
+                headers={"Content-Type": "application/json"})
+            res = json.loads(urllib.request.urlopen(req).read())["results"]
+            assert res[0][0]["index"] == 3
+            assert res[1][0]["index"] == 9
+        finally:
+            server.stop()
+
+
+class TestGraphEmbeddings:
+    def _two_cliques(self):
+        """Two 6-cliques joined by one bridge edge."""
+        edges = []
+        for base in (0, 6):
+            for i in range(6):
+                for j in range(i + 1, 6):
+                    edges.append((base + i, base + j))
+        edges.append((0, 6))
+        return Graph.from_edges(12, edges)
+
+    def test_deepwalk_community_structure(self):
+        g = self._two_cliques()
+        dw = DeepWalk(vector_size=16, window=3, walk_length=20,
+                      walks_per_vertex=8, epochs=2, seed=3)
+        dw.fit(g)
+        assert dw.vertex_vector(0).shape == (16,)
+        # same-clique similarity beats cross-clique
+        same = np.mean([dw.similarity(1, j) for j in range(2, 6)])
+        cross = np.mean([dw.similarity(1, j) for j in range(7, 12)])
+        assert same > cross
+
+    def test_node2vec_runs(self):
+        g = self._two_cliques()
+        nv = Node2Vec(p=0.5, q=2.0, vector_size=8, walk_length=10,
+                      walks_per_vertex=4, epochs=1, seed=4)
+        nv.fit(g)
+        assert nv.vertex_vector(11).shape == (8,)
+        near = nv.verts_nearest(3, 3)
+        assert len(near) == 3
+
+    def test_random_walks_respect_graph(self):
+        from deeplearning4j_tpu.graph import RandomWalkIterator
+
+        g = Graph.from_edges(4, [(0, 1), (1, 2), (2, 3)])
+        for walk in RandomWalkIterator(g, walk_length=10, seed=0):
+            for a, b in zip(walk, walk[1:]):
+                assert b in g.neighbors(a) or a == b

@@ -1,0 +1,137 @@
+"""Solver + gradient-accumulation tests (ports the intent of
+optimize/solver tests — BackTrackLineSearchTest, TestOptimizers — and the
+EncodingHandler threshold-compression contract)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Sgd
+from deeplearning4j_tpu.optimize.accumulation import (
+    BasicGradientsAccumulator,
+    EncodingHandler,
+    sparsify,
+    threshold_encode,
+    unsparsify,
+)
+from deeplearning4j_tpu.optimize.solvers import (
+    ConjugateGradient,
+    LBFGS,
+    LineGradientDescent,
+    Solver,
+)
+
+
+def _net(seed=3):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).updater(Sgd(learning_rate=0.1)).dtype("float64")
+            .list(DenseLayer(n_out=8, activation="tanh"),
+                  OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=40, seed=0):
+    rs = np.random.RandomState(seed)
+    labels = rs.randint(0, 3, n)
+    x = rs.randn(n, 4) + 1.5 * labels[:, None]
+    return x, np.eye(3)[labels]
+
+
+class TestSolvers:
+    @pytest.mark.parametrize("cls", [LineGradientDescent, ConjugateGradient,
+                                     LBFGS])
+    def test_optimizer_reduces_loss(self, cls):
+        net = _net()
+        x, y = _data()
+        s0 = net.score(x=x, y=y)
+        opt = cls(max_iterations=20)
+        final = opt.optimize(net, x, y)
+        assert final < s0 * 0.6, (s0, final)
+        assert abs(net.score(x=x, y=y) - final) < 1e-8
+
+    def test_lbfgs_converges_faster_than_line_gd(self):
+        """On a smooth problem L-BFGS should beat steepest descent for the
+        same iteration budget."""
+        x, y = _data()
+        n1, n2 = _net(), _net()
+        l_gd = LineGradientDescent(max_iterations=15).optimize(n1, x, y)
+        l_bfgs = LBFGS(max_iterations=15).optimize(n2, x, y)
+        assert l_bfgs <= l_gd + 1e-9
+
+    def test_solver_facade_dispatch(self):
+        net = _net()
+        x, y = _data()
+        s = Solver(net, algorithm="lbfgs", max_iterations=10)
+        final = s.optimize(x, y)
+        assert final < 1.2
+        with pytest.raises(ValueError, match="Unknown optimization"):
+            Solver(net, algorithm="newton_raphson")
+
+    def test_sgd_algorithm_uses_jitted_step(self):
+        net = _net()
+        x, y = _data()
+        s = Solver(net, algorithm="stochastic_gradient_descent")
+        before = net.iteration
+        s.optimize(x.astype(np.float64), y.astype(np.float64))
+        assert net.iteration == before + 1
+
+
+class TestThresholdCompression:
+    def test_encode_quantises_and_keeps_residual(self):
+        import jax.numpy as jnp
+
+        g = jnp.asarray([0.5, -0.3, 0.001, -0.0005, 0.0])
+        res = jnp.zeros(5)
+        msg, new_res = threshold_encode(g, res, jnp.float32(0.01))
+        assert np.allclose(msg, [0.01, -0.01, 0.0, 0.0, 0.0])
+        # residual holds exactly what was not transmitted
+        assert np.allclose(np.asarray(msg) + np.asarray(new_res),
+                           np.asarray(g), atol=1e-7)
+
+    def test_residual_error_feedback_transmits_eventually(self):
+        """Small gradients accumulate in the residual until they cross the
+        threshold — no information is permanently lost."""
+        h = EncodingHandler(threshold=0.1)
+        g = np.full(4, 0.03, np.float32)
+        sent = np.zeros(4)
+        for _ in range(10):
+            sent += np.asarray(h.encode(g))
+        # after 10 rounds of 0.03, ~0.3 worth must have been transmitted
+        assert np.all(sent >= 0.2)
+        total = sent + np.asarray(h._residual)
+        assert np.allclose(total, 0.3, atol=1e-6)
+
+    def test_sparse_wire_roundtrip(self):
+        msg = np.array([0.01, 0.0, -0.01, 0.0, 0.01], np.float32)
+        idx, signs = sparsify(msg, 0.01)
+        assert list(idx) == [0, 2, 4]
+        back = unsparsify(idx, signs, 0.01, 5)
+        assert np.allclose(back, msg)
+
+    def test_accumulator_matches_uncompressed_mean_over_time(self):
+        """Error-feedback compressed mean converges to the true mean of the
+        per-worker gradients over repeated rounds."""
+        rs = np.random.RandomState(7)
+        W, D = 4, 64
+        # threshold must exceed the per-round gradient magnitude for the
+        # error-feedback transmission to keep up (1-bit-SGD regime: each
+        # round moves at most +-threshold per coordinate)
+        theta = 0.05
+        grads = [np.clip(rs.randn(D) * 0.01, -0.04, 0.04).astype(np.float32)
+                 for _ in range(W)]
+        acc_c = BasicGradientsAccumulator(W, threshold=theta, compress=True)
+        total_c = np.zeros(D)
+        rounds = 30
+        for _ in range(rounds):
+            for w in range(W):
+                acc_c.store_update(w, grads[w])
+            total_c += np.asarray(acc_c.get_update())
+        true_total = np.mean(grads, axis=0) * rounds
+        # error bounded by ~threshold per coordinate (final residuals)
+        assert np.all(np.abs(total_c - true_total) <= 2 * theta + 1e-6)
