@@ -63,10 +63,12 @@ class ZooModel:
     """
 
     def __init__(self, num_labels: int = 1000, seed: int = 123,
-                 input_shape: Optional[tuple] = None, dtype: str = "float32"):
+                 input_shape: Optional[tuple] = None, dtype: str = "float32",
+                 compute_dtype: Optional[str] = None):
         self.num_labels = num_labels
         self.seed = seed
         self.dtype = dtype
+        self.compute_dtype = compute_dtype
         if input_shape is not None:
             self.input_shape = tuple(input_shape)
 
@@ -75,6 +77,7 @@ class ZooModel:
 
     def init(self):
         c = self.conf()
+        c.compute_dtype = self.compute_dtype
         net = (ComputationGraph(c)
                if type(c).__name__ == "ComputationGraphConfiguration"
                else MultiLayerNetwork(c))

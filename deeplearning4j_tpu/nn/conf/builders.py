@@ -78,6 +78,10 @@ class MultiLayerConfiguration:
     tbptt_back_length: int = 20
     pretrain: bool = False
     dtype: str = "float32"
+    # mixed precision: cast params+activations to this dtype inside the
+    # training loss (bfloat16 puts convs/matmuls on the MXU's fast path);
+    # None = compute in ``dtype``. The loss head always runs in ``dtype``.
+    compute_dtype: Optional[str] = None
     # per-layer input types computed at build time (after preprocessor)
     layer_input_types: list = field(default_factory=list)
 
@@ -189,6 +193,7 @@ class ListBuilder:
             tbptt_back_length=self._tbptt_back,
             pretrain=self._pretrain,
             dtype=g.dtype,
+            compute_dtype=g.compute_dtype,
         )
 
 
@@ -209,6 +214,7 @@ class NeuralNetConfiguration:
     dropout: Optional[float] = None
     updater: Updater = field(default_factory=lambda: Sgd(learning_rate=0.1))
     dtype: str = "float32"
+    compute_dtype: Optional[str] = None
     optimization_algo: str = "stochastic_gradient_descent"
 
     @staticmethod
@@ -265,6 +271,10 @@ class NeuralNetConfigurationBuilder:
 
     def dtype(self, dt: str):
         self._c.dtype = dt
+        return self
+
+    def compute_dtype(self, dt: Optional[str]):
+        self._c.compute_dtype = dt
         return self
 
     def optimization_algo(self, algo: str):

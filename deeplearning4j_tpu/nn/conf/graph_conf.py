@@ -435,6 +435,7 @@ class ComputationGraphConfiguration:
     tbptt_back_length: int = 20
     pretrain: bool = False
     dtype: str = "float32"
+    compute_dtype: Optional[str] = None
 
     def to_json(self) -> str:
         return serde.to_json(self)
@@ -592,4 +593,5 @@ class GraphBuilder:
             tbptt_back_length=self._tbptt_back,
             pretrain=self._pretrain,
             dtype=self._g.dtype,
+            compute_dtype=self._g.compute_dtype,
         )

@@ -136,9 +136,24 @@ class ComputationGraph:
         ys = _as_list(y)
         ims = _as_list(input_mask) or [None] * len(xs)
         lms = _as_list(label_mask) or [None] * len(ys)
+        cd = getattr(conf, "compute_dtype", None)
+        fwd_params = params
+        if cd is not None:
+            # mixed precision (see MultiLayerNetwork._loss): non-output
+            # vertices compute in cd; loss heads keep the param dtype
+            cdt = jnp.dtype(cd)
+            outs_set = set(conf.network_outputs)
+            fwd_params = {
+                k: (jax.tree_util.tree_map(lambda a: a.astype(cdt), v)
+                    if k not in outs_set else v)
+                for k, v in params.items()}
+            xs = [a.astype(cdt) for a in xs]
         _, new_states, new_carry, out_masks, loss_inputs = self._forward(
-            params, state, xs, ims, train=train, rng=rng, carry=carry,
+            fwd_params, state, xs, ims, train=train, rng=rng, carry=carry,
             collect_loss_inputs=True)
+        if cd is not None:
+            pdt = jnp.dtype(conf.dtype)
+            loss_inputs = {k: v.astype(pdt) for k, v in loss_inputs.items()}
         total = 0.0
         last_in_by_out = {}
         for j, name in enumerate(conf.network_outputs):
@@ -254,7 +269,9 @@ class ComputationGraph:
             jnp.asarray(self.iteration, jnp.float32), xs, ys, ims, lms,
             carry if with_carry else {})
         self.iteration += 1
-        self.score_value = float(loss)
+        # device scalar, not float(): no forced sync per step (see
+        # MultiLayerNetwork.do_step)
+        self.score_value = loss
         for listener in self.listeners:
             listener.iteration_done(self, self.iteration)
         return self.score_value, new_carry

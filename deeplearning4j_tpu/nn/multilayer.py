@@ -119,9 +119,24 @@ class MultiLayerNetwork:
     def _loss(self, params, state, x, y, input_mask, label_mask, *, train, rng,
               carry=None):
         out_idx = len(self.layers) - 1
+        cd = getattr(self.conf, "compute_dtype", None)
+        fwd_params = params
+        if cd is not None:
+            # mixed precision: body layers compute in cd (bfloat16 -> MXU
+            # fast path); the loss head and its params stay in the param
+            # dtype. Gradients flow back through the casts to full-precision
+            # leaves automatically.
+            cdt = jnp.dtype(cd)
+            fwd_params = {
+                k: (jax.tree_util.tree_map(lambda a: a.astype(cdt), v)
+                    if k != str(out_idx) else v)
+                for k, v in params.items()}
+            x = x.astype(cdt)
         last_in, new_states, new_carry, cur_mask = self._forward(
-            params, state, x, input_mask, train=train, rng=rng, carry=carry,
-            upto=out_idx)
+            fwd_params, state, x, input_mask, train=train, rng=rng,
+            carry=carry, upto=out_idx)
+        if cd is not None:
+            last_in = last_in.astype(jnp.dtype(self.conf.dtype))
         out_layer = self.layers[out_idx]
         if out_idx in self.conf.preprocessors:
             last_in = self.conf.preprocessors[out_idx].forward(last_in)
@@ -218,7 +233,9 @@ class MultiLayerNetwork:
             jnp.asarray(self.iteration, jnp.float32), x, y, input_mask, label_mask,
             carry if with_carry else {})
         self.iteration += 1
-        self.score_value = float(loss)
+        # score_value stays a device scalar: float() would force a sync every
+        # step and stall the dispatch pipeline; it coerces on first use
+        self.score_value = loss
         for listener in self.listeners:
             listener.iteration_done(self, self.iteration)
         return self.score_value, new_carry
