@@ -11,6 +11,18 @@ the reference's sequential row axpys up to summation order).
 Gradients are closed-form (logistic regression), not autodiff: the update is
 its own derivative, and hand-coding keeps it one fused kernel.
 
+Duplicate-row stabilisation: the reference applies pairs SEQUENTIALLY, so a
+row touched by many pairs is re-read after every axpy. A batched scatter
+instead accumulates all contributions computed from the SAME stale row; when
+one row appears hundreds of times in a batch (tiny vocab or very frequent
+word) the summed step grows with the duplicate count and training diverges
+(count * lr >> 1). Every scatter below therefore caps the accumulated
+per-row step at DUP_CAP effective contributions: scale = min(1, cap/count).
+Rows with <= cap duplicates per batch sum exactly like the reference; hotter
+rows get a bounded step (cap * lr < 1, the SGD stability region). A full
+mean (1/count) is NOT used — it collapses a whole batch into one effective
+step per row and stalls learning when batch >> vocab.
+
 HS pair layout: for each center/context pair, up to L huffman (point, code)
 levels with a validity mask. NS layout: K negatives per pair sampled on host
 from the unigram^0.75 table (reference: InMemoryLookupTable sampling table).
@@ -26,9 +38,24 @@ import jax.numpy as jnp
 import numpy as np
 
 
+DUP_CAP = 16.0  # max effective duplicate contributions per row per batch
+
+
+def _row_mean_scale(num_rows, idx, weights, cap):
+    """Per-element scale min(1, cap/count), where count is how much batch
+    weight lands on the element's destination row (see module docstring:
+    stale-read duplicate stabilisation). idx/weights: same shape; weight 0 =
+    padding. cap=inf disables the cap (pure reference-style summation — used
+    by doc2vec label training, where a single row takes a full-batch
+    gradient against near-frozen targets and summation is stable)."""
+    cnt = jnp.zeros((num_rows,), weights.dtype).at[idx].add(weights)
+    return jnp.minimum(1.0, cap / jnp.maximum(cnt[idx], 1.0))
+
+
 @partial(jax.jit, static_argnames=("use_hs", "use_ns"))
 def skipgram_step(syn0, syn1, syn1neg, centers, points, codes, code_mask,
-                  neg_targets, neg_labels, lr, *, use_hs: bool, use_ns: bool):
+                  neg_targets, neg_labels, lr, dup_cap, *, use_hs: bool,
+                  use_ns: bool):
     """One batched skipgram update.
 
     syn0: [V, D] input vectors; syn1: [V, D] HS inner nodes; syn1neg: [V, D].
@@ -37,6 +64,7 @@ def skipgram_step(syn0, syn1, syn1neg, centers, points, codes, code_mask,
     neg_targets: [B, 1+K] (positive target first), neg_labels: [B, 1+K].
     Returns updated (syn0, syn1, syn1neg).
     """
+    V = syn0.shape[0]
     h = syn0[centers]  # [B, D]
     grad_h = jnp.zeros_like(h)
 
@@ -47,7 +75,8 @@ def skipgram_step(syn0, syn1, syn1neg, centers, points, codes, code_mask,
         g = (1.0 - codes - f) * code_mask * lr
         grad_h = grad_h + jnp.einsum("bl,bld->bd", g, w1)
         dw1 = jnp.einsum("bl,bd->bld", g, h)
-        syn1 = syn1.at[points].add(dw1)
+        s1 = _row_mean_scale(V, points, code_mask, dup_cap)
+        syn1 = syn1.at[points].add(dw1 * s1[..., None])
 
     if use_ns:
         wn = syn1neg[neg_targets]  # [B, 1+K, D]
@@ -55,16 +84,77 @@ def skipgram_step(syn0, syn1, syn1neg, centers, points, codes, code_mask,
         g = (neg_labels - f) * lr
         grad_h = grad_h + jnp.einsum("bk,bkd->bd", g, wn)
         dwn = jnp.einsum("bk,bd->bkd", g, h)
-        syn1neg = syn1neg.at[neg_targets].add(dwn)
+        sn = _row_mean_scale(V, neg_targets,
+                             jnp.ones(neg_targets.shape, syn0.dtype),
+                             dup_cap)
+        syn1neg = syn1neg.at[neg_targets].add(dwn * sn[..., None])
 
-    syn0 = syn0.at[centers].add(grad_h)
+    s0 = _row_mean_scale(V, centers, jnp.ones(centers.shape, syn0.dtype),
+                         dup_cap)
+    syn0 = syn0.at[centers].add(grad_h * s0[:, None])
+    return syn0, syn1, syn1neg
+
+
+@partial(jax.jit, static_argnames=("use_hs", "use_ns"),
+         donate_argnums=(0, 1, 2))
+def skipgram_epoch(syn0, syn1, syn1neg, centers, points, codes, code_mask,
+                   neg_targets, neg_labels, pair_mask, lrs, dup_cap, *,
+                   use_hs: bool, use_ns: bool):
+    """A whole epoch of skipgram updates as ONE device program.
+
+    The reference's hot loop is a native per-pair op dispatched from Java
+    threads (SkipGram.java:271-272 AggregateSkipGram); the round-2 TPU port
+    still paid one host->device dispatch per 8k-pair batch, which capped
+    throughput at ~7k words/s. Here every batch of the epoch is pre-staged
+    on device and a ``lax.scan`` applies them back-to-back — zero host
+    round-trips inside the epoch, donated syn buffers, same math as
+    ``skipgram_step`` plus a per-pair validity mask for padding.
+
+    centers: [S, B]; points/codes/code_mask: [S, B, L];
+    neg_targets/neg_labels: [S, B, 1+K]; pair_mask: [S, B] (0 = padding);
+    lrs: [S] per-batch learning rate (linear decay precomputed on host).
+    """
+
+    V = syn0.shape[0]
+
+    def body(carry, xs):
+        syn0, syn1, syn1neg = carry
+        c, p, cd, cm, nt, nl, pm, lr = xs
+        h = syn0[c]
+        grad_h = jnp.zeros_like(h)
+        if use_hs:
+            w1 = syn1[p]
+            f = jax.nn.sigmoid(jnp.einsum("bd,bld->bl", h, w1))
+            g = (1.0 - cd - f) * cm * pm[:, None] * lr
+            grad_h = grad_h + jnp.einsum("bl,bld->bd", g, w1)
+            s1 = _row_mean_scale(V, p, cm * pm[:, None], dup_cap)
+            syn1 = syn1.at[p].add(jnp.einsum("bl,bd->bld", g, h)
+                                  * s1[..., None])
+        if use_ns:
+            wn = syn1neg[nt]
+            f = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", h, wn))
+            g = (nl - f) * pm[:, None] * lr
+            grad_h = grad_h + jnp.einsum("bk,bkd->bd", g, wn)
+            sn = _row_mean_scale(V, nt,
+                                 jnp.broadcast_to(pm[:, None], nt.shape),
+                                 dup_cap)
+            syn1neg = syn1neg.at[nt].add(jnp.einsum("bk,bd->bkd", g, h)
+                                         * sn[..., None])
+        s0 = _row_mean_scale(V, c, pm, dup_cap)
+        syn0 = syn0.at[c].add(grad_h * s0[:, None])
+        return (syn0, syn1, syn1neg), None
+
+    (syn0, syn1, syn1neg), _ = jax.lax.scan(
+        body, (syn0, syn1, syn1neg),
+        (centers, points, codes, code_mask, neg_targets, neg_labels,
+         pair_mask, lrs))
     return syn0, syn1, syn1neg
 
 
 @partial(jax.jit, static_argnames=("use_hs", "use_ns"))
 def cbow_step(syn0, syn1, syn1neg, context, context_mask, points, codes,
-              code_mask, neg_targets, neg_labels, lr, *, use_hs: bool,
-              use_ns: bool):
+              code_mask, neg_targets, neg_labels, lr, dup_cap, *,
+              use_hs: bool, use_ns: bool):
     """One batched CBOW update (reference: elements/CBOW.java — the context
     mean predicts the center; the input gradient is spread over the context).
 
@@ -72,6 +162,7 @@ def cbow_step(syn0, syn1, syn1neg, context, context_mask, points, codes,
     points/codes relate to the CENTER word's huffman path; neg_targets[...,0]
     is the center (label 1).
     """
+    V = syn0.shape[0]
     ctx_vec = syn0[context]  # [B, C, D]
     denom = jnp.maximum(context_mask.sum(axis=1, keepdims=True), 1.0)
     h = (ctx_vec * context_mask[..., None]).sum(axis=1) / denom  # [B, D]
@@ -82,18 +173,26 @@ def cbow_step(syn0, syn1, syn1neg, context, context_mask, points, codes,
         f = jax.nn.sigmoid(jnp.einsum("bd,bld->bl", h, w1))
         g = (1.0 - codes - f) * code_mask * lr
         grad_h = grad_h + jnp.einsum("bl,bld->bd", g, w1)
-        syn1 = syn1.at[points].add(jnp.einsum("bl,bd->bld", g, h))
+        s1 = _row_mean_scale(V, points, code_mask, dup_cap)
+        syn1 = syn1.at[points].add(jnp.einsum("bl,bd->bld", g, h)
+                                   * s1[..., None])
 
     if use_ns:
         wn = syn1neg[neg_targets]
         f = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", h, wn))
         g = (neg_labels - f) * lr
         grad_h = grad_h + jnp.einsum("bk,bkd->bd", g, wn)
-        syn1neg = syn1neg.at[neg_targets].add(jnp.einsum("bk,bd->bkd", g, h))
+        sn = _row_mean_scale(V, neg_targets,
+                             jnp.ones(neg_targets.shape, syn0.dtype),
+                             dup_cap)
+        syn1neg = syn1neg.at[neg_targets].add(jnp.einsum("bk,bd->bkd", g, h)
+                                              * sn[..., None])
 
-    # spread input gradient over contributing context words (mean -> /count)
+    # spread input gradient over contributing context words (mean -> /count),
+    # then normalise duplicate context rows across the batch
     per_ctx = (grad_h[:, None, :] * context_mask[..., None]) / denom[..., None]
-    syn0 = syn0.at[context].add(per_ctx)
+    sc = _row_mean_scale(V, context, context_mask, dup_cap)
+    syn0 = syn0.at[context].add(per_ctx * sc[..., None])
     return syn0, syn1, syn1neg
 
 
@@ -106,7 +205,7 @@ class BatchBuilder:
     InMemoryLookupTable.java:55-97,120 makeTable / SkipGram.java:215-224)."""
 
     def __init__(self, cache, window=5, negative=0, use_hs=True,
-                 sampling=0.0, table_size=100000, seed=12345,
+                 sampling=0.0, table_size=None, seed=12345,
                  max_code_length=40):
         self.cache = cache
         self.window = window
@@ -119,11 +218,25 @@ class BatchBuilder:
              for i in range(cache.num_words())), default=1) or 1
         self.max_code_len = min(self.max_code_len, max_code_length)
         counts = cache.counts_array()
+        if table_size is None:
+            # ~32 slots per word on average (capped) so even unigram^0.75
+            # tail words keep a nonzero draw probability; the reference's
+            # table is a fixed 1e8 entries (InMemoryLookupTable), far more
+            # memory for the same quantisation role
+            table_size = int(min(max(100000, 32 * cache.num_words()),
+                                 1 << 24))
         if self.negative > 0 and counts.size:
             p = counts ** 0.75
             self._neg_cum = np.cumsum(p / p.sum())
+            # quantised unigram^0.75 table (reference
+            # InMemoryLookupTable.makeTable): sampling = one randint + one
+            # gather instead of a searchsorted per draw
+            self._neg_table = np.searchsorted(
+                self._neg_cum,
+                (np.arange(table_size) + 0.5) / table_size).astype(np.int32)
         else:
             self._neg_cum = None
+            self._neg_table = None
         # precomputed huffman path arrays [V, L]
         V = cache.num_words()
         L = self.max_code_len
@@ -138,40 +251,61 @@ class BatchBuilder:
                 self.codes[i, :n] = w.codes[:n]
                 self.code_mask[i, :n] = 1.0
 
-    def sentence_to_indices(self, tokens) -> np.ndarray:
+    def lookup_indices(self, tokens) -> np.ndarray:
+        """Vocab indices for in-vocab tokens, NO subsampling (callers that
+        train multiple epochs re-draw subsampling per epoch)."""
         idx = [self.cache.index_of(t) for t in tokens]
-        idx = np.array([i for i in idx if i >= 0], np.int32)
-        if self.sampling > 0 and idx.size:
-            counts = self.cache.counts_array()
-            total = self.cache.total_word_count
-            freq = counts[idx] / total
-            # word2vec subsampling keep probability
-            keep_p = (np.sqrt(freq / self.sampling) + 1) * self.sampling / freq
-            idx = idx[self.rng.random_sample(idx.size) < keep_p]
-        return idx
+        return np.array([i for i in idx if i >= 0], np.int32)
+
+    def subsample(self, idx: np.ndarray) -> np.ndarray:
+        """One frequency-subsampling draw (word2vec keep probability)."""
+        if self.sampling <= 0 or not idx.size:
+            return idx
+        counts = self.cache.counts_array()
+        total = self.cache.total_word_count
+        freq = counts[idx] / total
+        keep_p = (np.sqrt(freq / self.sampling) + 1) * self.sampling / freq
+        return idx[self.rng.random_sample(idx.size) < keep_p]
+
+    def sentence_to_indices(self, tokens) -> np.ndarray:
+        return self.subsample(self.lookup_indices(tokens))
 
     def pairs_from_sentence(self, idx: np.ndarray):
-        """(centers, contexts) with the reference's shrinking random window
-        (b = rand % window), vectorised: one boolean mask per offset d in
-        [-window, window] instead of a per-word python loop."""
-        n = idx.size
-        if n < 2:
+        """(centers, contexts) for one sentence — same shrinking random
+        window as the corpus-level path (single source of truth)."""
+        return self.pairs_from_corpus([idx])
+
+    def pairs_from_corpus(self, sent_indices):
+        """All (center, context) pairs of a corpus in one vectorised pass.
+
+        ``sent_indices``: list of per-sentence index arrays. Same shrinking
+        random window as ``pairs_from_sentence`` (b = rand % window), but one
+        boolean mask per offset over the WHOLE concatenated corpus — the
+        per-sentence Python loop disappears. Sentence boundaries are enforced
+        by comparing the shifted position against each token's own sentence
+        start/end."""
+        sent_indices = [s for s in sent_indices if s.size]
+        if not sent_indices:
             return np.zeros(0, np.int32), np.zeros(0, np.int32)
-        win = self.window - self.rng.randint(0, self.window, size=n)  # [n]
+        lens = np.array([s.size for s in sent_indices])
+        idx = np.concatenate(sent_indices).astype(np.int32)
+        n = idx.size
+        starts = np.repeat(np.cumsum(lens) - lens, lens)   # [n] own-sentence start
+        ends = starts + np.repeat(lens, lens)              # [n] own-sentence end
         pos = np.arange(n)
+        win = self.window - self.rng.randint(0, self.window, size=n)
         centers, contexts = [], []
         for d in range(-self.window, self.window + 1):
             if d == 0:
                 continue
             j = pos + d
-            m = (np.abs(d) <= win) & (j >= 0) & (j < n)
+            m = (np.abs(d) <= win) & (j >= starts) & (j < ends)
             if m.any():
-                centers.append(idx[pos[m]])
+                centers.append(idx[m])
                 contexts.append(idx[j[m]])
         if not centers:
             return np.zeros(0, np.int32), np.zeros(0, np.int32)
-        return (np.concatenate(centers).astype(np.int32),
-                np.concatenate(contexts).astype(np.int32))
+        return (np.concatenate(centers), np.concatenate(contexts))
 
     def sample_negatives(self, positives: np.ndarray,
                          rng: Optional[np.random.RandomState] = None
@@ -182,8 +316,9 @@ class BatchBuilder:
         targets = np.empty((B, 1 + K), np.int32)
         targets[:, 0] = positives
         if K:
-            u = (rng or self.rng).random_sample((B, K))
-            targets[:, 1:] = np.searchsorted(self._neg_cum, u).astype(np.int32)
+            draws = (rng or self.rng).randint(
+                0, self._neg_table.size, size=(B, K))
+            targets[:, 1:] = self._neg_table[draws]
         return targets
 
     def neg_labels(self, B: int) -> np.ndarray:

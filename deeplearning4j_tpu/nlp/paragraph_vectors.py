@@ -110,18 +110,35 @@ class ParagraphVectors(SequenceVectors):
         return self
 
     def _fit_dbow(self, idx, label_ids, lr):
-        """Label row predicts every doc word (reference: DBOW.java)."""
+        """Label row predicts every doc word (reference: DBOW.java).
+
+        dup_cap=inf: the whole batch moves ONE label row, so the duplicate
+        cap would attenuate label training ~batch/16-fold; uncapped
+        summation is the full-batch gradient for that single row against
+        near-frozen word targets — stable, and matches the reference's
+        sequential accumulation."""
         for lab in label_ids:
             rows = np.full(idx.size, lab, np.int32)
             for s in range(0, idx.size, self.batch_size):
                 sl = slice(s, s + self.batch_size)
-                self._skipgram_batch(rows[sl], idx[sl], lr)
+                self._skipgram_batch(rows[sl], idx[sl], lr,
+                                     dup_cap=float("inf"))
+
+    def _train_indexed(self, idx, progress):
+        """trainWords=true: ordinary skipgram over the document's words
+        (reference: ParagraphVectors trainWords flag)."""
+        centers, contexts = self._builder.pairs_from_sentence(idx)
+        if centers.size:
+            self._skipgram_batch(contexts, centers, self._alpha(progress))
 
     def _fit_dm(self, idx, label_ids, lr):
-        """Label + window context predicts center (reference: DM.java)."""
+        """Label + window context predicts center (reference: DM.java).
+        dup_cap=inf for the same reason as DBOW (label id appears in every
+        context window)."""
         for lab in label_ids:
             extra = np.full(idx.size, lab, np.int32)
-            self._cbow_sentence(idx, lr, extra_context=extra)
+            self._cbow_sentence(idx, lr, extra_context=extra,
+                                dup_cap=float("inf"))
 
     # ------------------------------------------------------------- inference
     def infer_vector(self, text: str, learning_rate: float = 0.01,

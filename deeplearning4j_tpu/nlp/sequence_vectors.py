@@ -22,8 +22,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.nlp.learning import (
+    DUP_CAP,
     BatchBuilder,
     cbow_step,
+    skipgram_epoch,
     skipgram_step,
 )
 from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
@@ -98,11 +100,14 @@ class SequenceVectors:
             self.build_vocab(sentences)
         if self.syn0 is None:
             self.reset_weights()
+        if self.elements_algorithm == "skipgram":
+            return self._fit_skipgram_epochs(sentences)
+        if self.elements_algorithm != "cbow":
+            raise ValueError("Unknown elements algorithm "
+                             f"'{self.elements_algorithm}'")
         total_words = max(self.vocab.total_word_count, 1.0)
         total_expected = total_words * self.epochs * self.iterations
         seen = 0.0
-        pend_rows, pend_pred = [], []
-        pending = 0
         for _ in range(self.epochs):
             if hasattr(sentences, "reset"):
                 sentences.reset()
@@ -111,55 +116,143 @@ class SequenceVectors:
                     if isinstance(sentence, str) else list(sentence)
                 idx = self._builder.sentence_to_indices(tokens)
                 for _ in range(self.iterations):
-                    if self.elements_algorithm == "skipgram":
-                        centers, contexts = \
-                            self._builder.pairs_from_sentence(idx)
-                        if centers.size:
-                            # syn0 rows = context words; predicted = centers
-                            pend_rows.append(contexts)
-                            pend_pred.append(centers)
-                            pending += centers.size
-                    elif self.elements_algorithm == "cbow":
-                        self._cbow_sentence(
-                            idx, self._alpha(seen / total_expected))
-                    else:
-                        raise ValueError("Unknown elements algorithm "
-                                         f"'{self.elements_algorithm}'")
-                while pending >= self.batch_size:
-                    pending = self._flush_pairs(
-                        pend_rows, pend_pred, pending,
-                        self._alpha(seen / total_expected))
+                    self._cbow_sentence(
+                        idx, self._alpha(seen / total_expected))
                 seen += idx.size
-        if pending:
-            rows = np.concatenate(pend_rows)
-            pred = np.concatenate(pend_pred)
-            self._skipgram_batch(rows, pred, self._alpha(1.0))
         return self
 
-    def _flush_pairs(self, pend_rows, pend_pred, pending, lr) -> int:
-        """Emit exactly batch_size pairs (constant XLA shapes); keep the rest
-        buffered."""
-        rows = np.concatenate(pend_rows)
-        pred = np.concatenate(pend_pred)
-        self._skipgram_batch(rows[:self.batch_size], pred[:self.batch_size],
-                             lr)
-        rest_r, rest_p = rows[self.batch_size:], pred[self.batch_size:]
-        pend_rows.clear()
-        pend_pred.clear()
-        if rest_r.size:
-            pend_rows.append(rest_r)
-            pend_pred.append(rest_p)
-        return rest_r.size
+    def _fit_skipgram_epochs(self, sentences) -> "SequenceVectors":
+        """Device-resident skipgram training: tokenize once, generate every
+        (center, context) pair of an epoch in one vectorised host pass
+        (``BatchBuilder.pairs_from_corpus``), pad to [S, batch_size], and run
+        ONE jitted ``lax.scan`` per epoch (``skipgram_epoch``). Epochs share
+        a padded batch count so the program compiles once.
+
+        Pair order is shuffled within an epoch (the per-offset vectorised
+        generation already abandons strict corpus order; a permutation
+        decorrelates batches). LR decays linearly over batches to
+        min_learning_rate, matching the reference's words-seen decay."""
+        b = self._builder
+        if hasattr(sentences, "reset"):
+            sentences.reset()
+        # Tokenize + vocab-index once (no subsampling yet); group sentences
+        # into blocks of ~BLOCK_TOKENS so pair arrays are generated
+        # streaming per block, not for the whole corpus at once — host
+        # memory stays O(block), a 100M-token corpus never materialises
+        # tens of GB of pairs.
+        BLOCK_TOKENS = 1 << 21
+        blocks, cur, cur_tokens, total_tokens = [], [], 0, 0
+        for sentence in sentences:
+            tokens = self.tokenizer_factory.create(sentence).tokens() \
+                if isinstance(sentence, str) else list(sentence)
+            idx = b.lookup_indices(tokens)
+            if idx.size == 0:
+                continue
+            cur.append(idx)
+            cur_tokens += idx.size
+            total_tokens += idx.size
+            if cur_tokens >= BLOCK_TOKENS:
+                blocks.append(cur)
+                cur, cur_tokens = [], 0
+        if cur:
+            blocks.append(cur)
+        B = self.batch_size
+        chunk = 128  # max scan batches per dispatch (bounds staging memory)
+        done, n_total = 0, 0
+        for e in range(self.epochs):
+            for bi, block in enumerate(blocks):
+                # fresh subsampling draw and dynamic windows per epoch
+                # (reference resamples both every pass over the corpus)
+                cs, xs = [], []
+                for _ in range(self.iterations):
+                    # fresh subsampling draw and dynamic windows per
+                    # iteration and epoch (reference resamples both on
+                    # every pass over the corpus)
+                    sent_idx = [b.subsample(sid) for sid in block] \
+                        if self.sampling > 0 else block
+                    ci, xi = b.pairs_from_corpus(sent_idx)
+                    cs.append(ci)
+                    xs.append(xi)
+                centers = np.concatenate(cs)
+                contexts = np.concatenate(xs)
+                if not centers.size:
+                    continue
+                perm = b.rng.permutation(centers.size)
+                centers, contexts = centers[perm], contexts[perm]
+                if n_total == 0:
+                    # LR-schedule denominator, set at the first non-empty
+                    # block: pairs per RAW token (subsampling ratio folds
+                    # in automatically) extrapolated over the corpus;
+                    # progress is clamped to 1 in _skipgram_dispatch
+                    per_tok = centers.size / max(
+                        sum(sid.size for sid in block), 1)
+                    n_total = max(int(per_tok * total_tokens) * self.epochs,
+                                  1)
+                off = 0
+                while off < centers.size:
+                    take = min(chunk * B, centers.size - off)
+                    done = self._skipgram_dispatch(
+                        centers[off:off + take], contexts[off:off + take],
+                        done, n_total)
+                    off += take
+        return self
+
+    def _skipgram_dispatch(self, centers, contexts, done, n_total) -> int:
+        """Stage one chunk of pairs as [S, B] device arrays and run the
+        jitted epoch scan. S is padded to a power of two so at most
+        log2(chunk)+1 program shapes ever compile."""
+        b, B = self._builder, self.batch_size
+        P, L, K = centers.size, b.max_code_len, self.negative
+        S = 1
+        while S * B < P:
+            S *= 2
+        pad = S * B - P
+        # predicted word = center (its huffman path / NS positive); the syn0
+        # row that moves = context (reference SkipGram iterateSample
+        # (currentWord=center, lastWord=context) updates syn0[lastWord])
+        rows = np.concatenate([contexts, np.zeros(pad, np.int32)])
+        pred = np.concatenate([centers, np.zeros(pad, np.int32)])
+        mask = np.concatenate([np.ones(P, np.float32),
+                               np.zeros(pad, np.float32)])
+        if self.use_hs:
+            points = b.points[pred].reshape(S, B, L)
+            codes = b.codes[pred].reshape(S, B, L)
+            cmask = b.code_mask[pred].reshape(S, B, L)
+        else:  # dummy single-level arrays keep the jit signature static
+            points = np.zeros((S, B, 1), np.int32)
+            codes = np.zeros((S, B, 1), np.float32)
+            cmask = np.zeros((S, B, 1), np.float32)
+        if K > 0:
+            negs = b.sample_negatives(pred).reshape(S, B, 1 + K)
+            nlab = np.zeros((S, B, 1 + K), np.float32)
+            nlab[..., 0] = 1.0
+        else:
+            negs = np.zeros((S, B, 1), np.int32)
+            nlab = np.zeros((S, B, 1), np.float32)
+        # linear LR decay by global pair progress (reference: alpha by words
+        # seen), floored at min_learning_rate
+        prog = np.minimum((done + np.arange(S) * B) / n_total, 1.0)
+        lrs = np.maximum(self.min_learning_rate,
+                         self.learning_rate * (1.0 - prog)).astype(np.float32)
+        self.syn0, self.syn1, self.syn1neg = skipgram_epoch(
+            self.syn0, self.syn1, self.syn1neg,
+            jnp.asarray(rows.reshape(S, B)),
+            jnp.asarray(points), jnp.asarray(codes), jnp.asarray(cmask),
+            jnp.asarray(negs), jnp.asarray(nlab),
+            jnp.asarray(mask.reshape(S, B)), jnp.asarray(lrs),
+            jnp.float32(DUP_CAP), use_hs=self.use_hs, use_ns=K > 0)
+        return done + P
 
     def _alpha(self, progress: float) -> float:
         return max(self.min_learning_rate,
                    self.learning_rate * (1.0 - progress))
 
     def _skipgram_batch(self, rows: np.ndarray, predicted: np.ndarray,
-                        lr: float) -> None:
+                        lr: float, dup_cap: float = DUP_CAP) -> None:
         """rows: syn0 rows to move (context words); predicted: words whose
         huffman path / positive NS target is used (reference
-        SkipGram.iterateSample(currentWord=predicted, lastWord=row))."""
+        SkipGram.iterateSample(currentWord=predicted, lastWord=row)).
+        dup_cap=inf restores pure summation (doc2vec label training)."""
         b = self._builder
         points, codes, mask = b.hs_arrays(predicted)
         negs = b.sample_negatives(predicted)
@@ -167,10 +260,12 @@ class SequenceVectors:
             self.syn0, self.syn1, self.syn1neg, jnp.asarray(rows),
             jnp.asarray(points), jnp.asarray(codes), jnp.asarray(mask),
             jnp.asarray(negs), jnp.asarray(b.neg_labels(rows.size)),
-            jnp.float32(lr), use_hs=self.use_hs, use_ns=self.negative > 0)
+            jnp.float32(lr), jnp.float32(dup_cap),
+            use_hs=self.use_hs, use_ns=self.negative > 0)
 
     def _cbow_sentence(self, idx: np.ndarray, lr: float,
-                       extra_context: Optional[np.ndarray] = None) -> None:
+                       extra_context: Optional[np.ndarray] = None,
+                       dup_cap: float = DUP_CAP) -> None:
         """Assemble [B, C] context windows per center word, one jitted step.
         ``extra_context`` (e.g. a paragraph label id per sequence) is
         prepended to every window (the DM trick)."""
@@ -201,7 +296,8 @@ class SequenceVectors:
             jnp.asarray(cmask), jnp.asarray(points), jnp.asarray(codes),
             jnp.asarray(mask), jnp.asarray(negs),
             jnp.asarray(b.neg_labels(B)), jnp.float32(lr),
-            use_hs=self.use_hs, use_ns=self.negative > 0)
+            jnp.float32(dup_cap), use_hs=self.use_hs,
+            use_ns=self.negative > 0)
 
     # ------------------------------------------------------------ query API
     def word_vector(self, word: str) -> Optional[np.ndarray]:

@@ -130,6 +130,25 @@ class TestWord2Vec:
         night_words = {"night", "dark", "star", "cold", "midnight"}
         assert len(night_words & set(near)) >= 1
 
+    def test_tiny_vocab_large_batch_stays_finite_and_learns(self):
+        # regression: batch >> vocab means hundreds of duplicate scatter
+        # contributions per row per batch; without the DUP_CAP per-row step
+        # cap (learning.py _row_mean_scale) the summed stale-gradient update
+        # diverged to NaN within a few batches
+        rs = np.random.RandomState(42)
+        topic_a = ["cat", "dog", "bird", "fish", "horse", "cow"]
+        topic_b = ["hammer", "wrench", "drill", "saw", "pliers", "chisel"]
+        sentences = [" ".join(rs.choice(topic_a if rs.rand() < 0.5
+                                        else topic_b, 8))
+                     for _ in range(1500)]
+        w2v = Word2Vec(layer_size=32, window=5, min_word_frequency=1,
+                       epochs=3, negative=5, use_hierarchic_softmax=False,
+                       batch_size=4096, seed=1)
+        w2v.fit(CollectionSentenceIterator(sentences))
+        assert np.all(np.isfinite(np.asarray(w2v.syn0)))
+        near = [w for w, _ in w2v.words_nearest("cat", 5)]
+        assert all(w in topic_a for w in near), near
+
     def test_binary_serde_roundtrip(self, tmp_path):
         from deeplearning4j_tpu.nlp.serde import (
             load_word2vec,
@@ -198,7 +217,7 @@ class TestSkipGramGradient:
         new0, new1, _ = skipgram_step(
             syn0, syn1, jnp.zeros_like(syn1), centers, points, codes, mask,
             jnp.zeros((1, 1), jnp.int32), jnp.zeros((1, 1), jnp.float32),
-            jnp.float32(lr), use_hs=True, use_ns=False)
+            jnp.float32(lr), jnp.float32(16.0), use_hs=True, use_ns=False)
         assert np.allclose(np.asarray(new0), np.asarray(syn0 - lr * g0),
                            atol=1e-5)
         assert np.allclose(np.asarray(new1), np.asarray(syn1 - lr * g1),
@@ -230,7 +249,7 @@ class TestSkipGramGradient:
             syn0, jnp.zeros_like(syn0), syn1neg, centers,
             jnp.zeros((2, 1), jnp.int32), jnp.zeros((2, 1), jnp.float32),
             jnp.zeros((2, 1), jnp.float32), negt, negl,
-            jnp.float32(lr), use_hs=False, use_ns=True)
+            jnp.float32(lr), jnp.float32(16.0), use_hs=False, use_ns=True)
         assert np.allclose(np.asarray(new0), np.asarray(syn0 - lr * g0),
                            atol=1e-5)
         assert np.allclose(np.asarray(newn), np.asarray(syn1neg - lr * gn),
