@@ -126,10 +126,14 @@ class ParagraphVectors(SequenceVectors):
 
     def _train_indexed(self, idx, progress):
         """trainWords=true: ordinary skipgram over the document's words
-        (reference: ParagraphVectors trainWords flag)."""
+        (reference: ParagraphVectors trainWords flag). Sliced to batch_size
+        like _fit_dbow so XLA shapes stay bounded instead of specialising
+        on every document's pair count."""
         centers, contexts = self._builder.pairs_from_sentence(idx)
-        if centers.size:
-            self._skipgram_batch(contexts, centers, self._alpha(progress))
+        lr = self._alpha(progress)
+        for s in range(0, centers.size, self.batch_size):
+            sl = slice(s, s + self.batch_size)
+            self._skipgram_batch(contexts[sl], centers[sl], lr)
 
     def _fit_dm(self, idx, label_ids, lr):
         """Label + window context predicts center (reference: DM.java).

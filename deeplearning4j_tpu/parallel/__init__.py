@@ -11,6 +11,12 @@ spanning hosts after `jax.distributed.initialize` (see distributed.py).
 """
 
 from deeplearning4j_tpu.parallel.trainer import ParallelWrapper
+from deeplearning4j_tpu.parallel.mesh import data_model_mesh
+from deeplearning4j_tpu.parallel.model_sharding import (
+    ShardedTrainer,
+    network_param_specs,
+    shard_network,
+)
 from deeplearning4j_tpu.parallel.inference import ParallelInference
 from deeplearning4j_tpu.parallel.evaluation import evaluate_on_mesh
 from deeplearning4j_tpu.parallel.mesh import data_mesh

@@ -1,0 +1,187 @@
+"""Tensor-parallel training of real networks via GSPMD sharding.
+
+Beyond reference parity (SURVEY §2.4 checklist: "TP: absent" in DL4J;
+the charter requires it as an idiomatic TPU extension). Design: instead of
+rewriting layer math shard_map-style, the NETWORK'S OWN jitted train step
+(nn/multilayer.py _make_step / nn/graph.py equivalent) is compiled against
+parameters placed with per-layer ``NamedSharding``s on a (data, model) mesh
+and batches sharded over ``data`` — XLA's SPMD partitioner inserts the
+collectives (the "pick a mesh, annotate shardings, let the compiler do the
+rest" recipe). The math is bit-identical to the single-device program up to
+float reduction order, which is what makes the dp x tp == single-device
+parity test possible.
+
+Sharding rules (gated on divisibility by the model-axis size; anything
+indivisible stays replicated):
+
+- kernels (ndim >= 2): output axis (last) sharded -> Megatron column style;
+  activations come out channel-sharded and the next layer consumes them.
+- embedding tables ([V, D] used via take(axis=0), layers named
+  EmbeddingLayer): VOCAB rows sharded (axis 0) — each device owns a slice
+  of the vocabulary.
+- biases / per-channel scales (ndim == 1): sharded to match the kernel's
+  output-channel sharding.
+- updater state: mirrors the param tree's shardings (Adam m/v etc. are
+  zeros_like(params) trees — see nn/updater.py).
+- layer state (BN running stats, ...): replicated — small, and replication
+  keeps every case correct.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+__all__ = ["network_param_specs", "shard_network", "ShardedTrainer",
+           "data_batch_sharding"]
+
+
+def _leaf_spec(arr, model_size: int, *, embedding: bool) -> P:
+    shape = np.shape(arr)
+    if len(shape) == 0:
+        return P()
+    if embedding and len(shape) == 2 and shape[0] % model_size == 0:
+        return P(MODEL_AXIS, None)  # vocab-row sharding
+    if shape[-1] % model_size == 0 and shape[-1] >= model_size:
+        return P(*([None] * (len(shape) - 1) + [MODEL_AXIS]))
+    return P()
+
+
+def _layer_of(net, key: str):
+    """The layer object behind a param-tree top key, for MLN (int index keys)
+    and ComputationGraph (vertex-name keys with .layer), else None."""
+    layers = getattr(net, "layers", None)
+    if isinstance(layers, list) and key.isdigit() and int(key) < len(layers):
+        return layers[int(key)]
+    vertices = getattr(getattr(net, "conf", None), "vertices", None)
+    if isinstance(vertices, dict) and key in vertices:
+        return getattr(vertices[key], "layer", vertices[key])
+    return None
+
+
+def network_param_specs(net, model_size: int) -> dict:
+    """PartitionSpec tree matching ``net.params`` under the rules above."""
+    specs = {}
+    for key, sub in net.params.items():
+        layer = _layer_of(net, key)
+        is_emb = type(layer).__name__ == "EmbeddingLayer"
+        specs[key] = {name: _leaf_spec(arr, model_size, embedding=is_emb)
+                      for name, arr in sub.items()}
+    return specs
+
+
+def data_batch_sharding(mesh: Mesh, arr) -> NamedSharding:
+    """Batch (axis 0) sharded over ``data``, rest replicated."""
+    nd = np.ndim(arr)
+    return NamedSharding(mesh, P(*([DATA_AXIS] + [None] * (nd - 1))))
+
+
+def shard_network(net, mesh: Mesh) -> dict:
+    """Place net.params / updater_state / state on the mesh (params +
+    updater state per-layer sharded, layer state replicated). Returns the
+    param spec tree."""
+    m = mesh.shape[MODEL_AXIS]
+    pspecs = network_param_specs(net, m)
+    put = jax.tree_util.tree_map(
+        lambda a, sp: jax.device_put(a, NamedSharding(mesh, sp)),
+        net.params, pspecs)
+    net.params = put
+    ptree = jax.tree_util.tree_structure(net.params)
+    new_us = {}
+    for key, sub in net.updater_state.items():
+        if jax.tree_util.tree_structure(sub) == ptree:
+            new_us[key] = jax.tree_util.tree_map(
+                lambda a, sp: jax.device_put(a, NamedSharding(mesh, sp)),
+                sub, pspecs)
+        else:
+            new_us[key] = jax.device_put(sub, NamedSharding(mesh, P()))
+    net.updater_state = new_us
+    net.state = jax.device_put(net.state, NamedSharding(mesh, P()))
+    return pspecs
+
+
+class _PlacedDataSet(DataSet):
+    """DataSet holding already-placed (sharded) jax arrays — the base
+    __init__'s np.asarray would pull them back to host, so it is bypassed.
+    Being a DataSet subclass keeps isinstance routing in net.fit working."""
+
+    def __init__(self, features, labels, features_mask=None,
+                 labels_mask=None):
+        self.features = features
+        self.labels = labels
+        self.features_mask = features_mask
+        self.labels_mask = labels_mask
+
+
+class _PlacedIterator:
+    """Wraps a DataSetIterator, yielding mesh-placed batches."""
+
+    def __init__(self, it, place):
+        self._it = it
+        self._place = place
+
+    def __iter__(self):
+        return (self._place(ds) for ds in self._it)
+
+    def reset(self):
+        if hasattr(self._it, "reset"):
+            self._it.reset()
+
+
+class ShardedTrainer:
+    """dp x tp trainer: any net with the ``do_step`` contract trains with
+    parameters tensor-sharded over ``model`` and batches sharded over
+    ``data``. The per-device batch is batch_size / mesh.shape['data'];
+    batch_size must divide evenly (static shapes keep one XLA program).
+
+    >>> mesh = data_model_mesh(4, 2)
+    >>> trainer = ShardedTrainer(net, mesh)
+    >>> trainer.fit(iterator, epochs=2)
+    """
+
+    def __init__(self, net, mesh: Mesh):
+        if DATA_AXIS not in mesh.shape or MODEL_AXIS not in mesh.shape:
+            raise ValueError(
+                f"mesh must have ({DATA_AXIS}, {MODEL_AXIS}) axes, got "
+                f"{dict(mesh.shape)}")
+        self.net = net
+        self.mesh = mesh
+        self.param_specs = shard_network(net, mesh)
+
+    def _place_ds(self, ds):
+        d = self.mesh.shape[DATA_AXIS]
+        feats = np.asarray(ds.features)
+        if feats.shape[0] % d != 0:
+            raise ValueError(
+                f"batch size {feats.shape[0]} not divisible by data-axis "
+                f"size {d}")
+        out = []
+        for a in (feats, np.asarray(ds.labels),
+                  ds.features_mask, ds.labels_mask):
+            if a is None:
+                out.append(None)
+                continue
+            a = np.asarray(a)
+            out.append(jax.device_put(a, data_batch_sharding(self.mesh, a)))
+        return _PlacedDataSet(*out)
+
+    def fit(self, iterator, epochs: int = 1):
+        """Delegates to the net's own fit (listeners, epochs, TBPTT routing
+        all apply); this wrapper only places each minibatch data-sharded on
+        the mesh before the step sees it."""
+        if isinstance(iterator, DataSet):
+            return self.net.fit(self._place_ds(iterator), epochs=epochs)
+        return self.net.fit(_PlacedIterator(iterator, self._place_ds),
+                            epochs=epochs)
+
+    def output(self, x):
+        """Sharded inference: batch over data, params stay tensor-sharded."""
+        x = np.asarray(x)
+        return self.net.output(
+            jax.device_put(x, data_batch_sharding(self.mesh, x)))

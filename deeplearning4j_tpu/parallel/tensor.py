@@ -8,9 +8,10 @@ over the model axis (Shoeybi et al. 2019). On a 2-D (data, model) mesh this
 composes freely with the data-parallel trainer: batch sharded over "data",
 weights over "model".
 
-These are building blocks: ``tp_mlp_block`` is the fused two-layer pattern;
-``shard_dense_params`` produces the per-device weight shards from full
-weights for checkpoint interchange.
+These are building blocks: ``tp_mlp_block`` is the fused two-layer shard_map
+pattern. For tensor-parallel training of full networks (MultiLayerNetwork /
+ComputationGraph / zoo models) use ``parallel.model_sharding.ShardedTrainer``,
+which shards the network's own jitted step via GSPMD instead.
 """
 
 from __future__ import annotations
@@ -50,12 +51,6 @@ def tp_specs():
     """PartitionSpecs for (x, w1, b1, w2, b2) of tp_mlp_block."""
     return (P(DATA_AXIS, None), P(None, MODEL_AXIS), P(MODEL_AXIS),
             P(MODEL_AXIS, None), P(None))
-
-
-def shard_dense_params(w1, b1, w2, b2):
-    """Full weights -> the sharded layout tp_mlp_block expects (identity
-    values; sharding happens via jax.device_put/with the specs above)."""
-    return w1, b1, w2, b2
 
 
 def tp_mlp_train_step(mesh: Mesh, activation, loss_fn, lr: float = 0.1):

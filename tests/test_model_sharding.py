@@ -1,0 +1,148 @@
+"""Tensor-parallel (GSPMD dp x tp) training of real networks.
+
+The core invariant mirrors the reference's distributed-equals-local contract
+(TestCompareParameterAveragingSparkVsSingleMachine.java, adapted to TP):
+the SAME train step compiled against a (data, model) mesh with tensor-sharded
+parameters must produce the single-device result to float tolerance — GSPMD
+partitions the program, it does not change the math.
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers.convolution import (
+    ConvolutionLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.conf.layers.core import (
+    DenseLayer,
+    EmbeddingLayer,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Adam, Sgd
+from deeplearning4j_tpu.parallel import ShardedTrainer, data_model_mesh
+from deeplearning4j_tpu.parallel.mesh import MODEL_AXIS
+from deeplearning4j_tpu.parallel.model_sharding import network_param_specs
+
+
+def _cnn(seed=7):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).updater(Adam(learning_rate=1e-3))
+            .list(ConvolutionLayer(n_out=8, kernel_size=(3, 3),
+                                   activation="relu"),
+                  SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)),
+                  DenseLayer(n_out=16, activation="relu"),
+                  OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(12, 12, 1))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _batches(n=3, b=4, seed=0):
+    rs = np.random.RandomState(seed)
+    return [DataSet(rs.randn(b, 12, 12, 1).astype(np.float32),
+                    np.eye(4, dtype=np.float32)[rs.randint(0, 4, b)])
+            for _ in range(n)]
+
+
+class TestShardedTrainer:
+    def test_specs_shard_kernels_and_biases(self):
+        net = _cnn()
+        specs = network_param_specs(net, model_size=2)
+        assert specs["0"]["W"] == P(None, None, None, MODEL_AXIS)
+        assert specs["0"]["b"] == P(MODEL_AXIS)
+        assert specs["2"]["W"] == P(None, MODEL_AXIS)
+        assert specs["3"]["W"] == P(None, MODEL_AXIS)  # 4 % 2 == 0
+
+    def test_indivisible_dims_stay_replicated(self):
+        net = _cnn()
+        specs = network_param_specs(net, model_size=3)
+        # 8 % 3 != 0 -> replicated
+        assert specs["0"]["W"] == P()
+        assert specs["0"]["b"] == P()
+
+    def test_dp_tp_matches_single_device(self):
+        ref = _cnn()
+        tp = _cnn()
+        batches = _batches()
+        for ds in batches:
+            ref.do_step(ds.features, ds.labels)
+
+        mesh = data_model_mesh(2, 2)
+        trainer = ShardedTrainer(tp, mesh)
+        # placed params really are tensor-sharded over the model axis
+        assert tp.params["0"]["W"].sharding.spec == P(
+            None, None, None, MODEL_AXIS)
+        trainer.fit(batches)
+
+        for k in ref.params:
+            for name in ref.params[k]:
+                np.testing.assert_allclose(
+                    np.asarray(ref.params[k][name]),
+                    np.asarray(tp.params[k][name]),
+                    rtol=2e-4, atol=2e-5,
+                    err_msg=f"param {k}/{name} diverged under dp x tp")
+        out_ref = np.asarray(ref.output(batches[0].features))
+        out_tp = np.asarray(trainer.output(batches[0].features))
+        np.testing.assert_allclose(out_ref, out_tp, rtol=2e-4, atol=2e-5)
+
+    def test_embedding_vocab_rows_sharded(self):
+        conf = (NeuralNetConfiguration.builder()
+                .seed(3).updater(Sgd(learning_rate=0.1))
+                .list(EmbeddingLayer(n_in=32, n_out=8,
+                                     activation="identity"),
+                      OutputLayer(n_in=8, n_out=4, activation="softmax",
+                                  loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        specs = network_param_specs(net, model_size=2)
+        assert specs["0"]["W"] == P(MODEL_AXIS, None)  # vocab rows
+
+        mesh = data_model_mesh(2, 2)
+        trainer = ShardedTrainer(net, mesh)
+        rs = np.random.RandomState(1)
+        x = rs.randint(0, 32, (8, 1)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, 8)]
+        before = np.asarray(net.params["0"]["W"]).copy()
+        trainer.fit(DataSet(x, y))
+        assert np.isfinite(float(net.score_value))
+        assert not np.allclose(before, np.asarray(net.params["0"]["W"]))
+
+    def test_batch_not_divisible_raises(self):
+        net = _cnn()
+        trainer = ShardedTrainer(net, data_model_mesh(2, 2))
+        bad = DataSet(np.zeros((3, 12, 12, 1), np.float32),
+                      np.eye(4, dtype=np.float32)[np.zeros(3, int)])
+        with pytest.raises(ValueError, match="not divisible"):
+            trainer.fit(bad)
+
+    def test_updater_state_sharded_like_params(self):
+        net = _cnn()
+        ShardedTrainer(net, data_model_mesh(2, 2))
+        assert net.updater_state["m"]["0"]["W"].sharding.spec == P(
+            None, None, None, MODEL_AXIS)
+
+
+@pytest.mark.slow
+class TestZooTensorParallel:
+    def test_vgg16_trains_dp_tp(self):
+        from deeplearning4j_tpu.models import VGG16
+
+        net = VGG16(num_labels=8, input_shape=(32, 32, 3)).init()
+        mesh = data_model_mesh(2, 4)
+        trainer = ShardedTrainer(net, mesh)
+        # all VGG conv stacks (64..512 channels) divide by 4: every kernel
+        # is genuinely tensor-sharded
+        assert net.params["0"]["W"].sharding.spec == P(
+            None, None, None, MODEL_AXIS)
+        rs = np.random.RandomState(0)
+        x = rs.randn(4, 32, 32, 3).astype(np.float32)
+        y = np.eye(8, dtype=np.float32)[rs.randint(0, 8, 4)]
+        trainer.fit(DataSet(x, y))
+        assert np.isfinite(float(net.score_value))
