@@ -30,8 +30,16 @@ NORTH_STAR_RESNET50_IMG_S = 84.0  # 70% of est. 120 img/s nd4j-cuda
 
 
 def _sync(x):
+    """Force execution to completion via a host fetch of a scalar that is
+    data-dependent on ``x``. jax.block_until_ready is NOT sufficient on the
+    tunneled TPU backend (it returns before device execution finishes, which
+    silently turns timing loops into dispatch-rate measurements); a host
+    transfer cannot complete before the producing program has."""
     import jax
-    jax.block_until_ready(x)
+    import jax.numpy as jnp
+
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    return float(jnp.sum(jnp.ravel(leaf)[:1]))
 
 
 def _steady_state_img_s(net, x, y, steps: int):
@@ -52,6 +60,11 @@ def _steady_state_img_s(net, x, y, steps: int):
     rng = jax.random.PRNGKey(0)
 
     def run(n, params, opt, state):
+        # the step donates params/opt/state buffers: each run gets its own
+        # copies (made OUTSIDE the timed region)
+        params, opt, state = jax.tree_util.tree_map(
+            lambda a: a.copy(), (params, opt, state))
+        _sync(params)
         t0 = time.perf_counter()
         for i in range(n):
             params, opt, state, _, loss = step(
@@ -60,13 +73,15 @@ def _steady_state_img_s(net, x, y, steps: int):
         _sync(params)
         return time.perf_counter() - t0, loss
 
-    params, opt, state = net.params, net.updater_state, net.state
-    params, opt, state, _, _ = step(params, opt, state, rng,
-                                    jnp.float32(0), xd, yd, None, None, {})
+    params0, opt0, state0 = jax.tree_util.tree_map(
+        lambda a: a.copy(), (net.params, net.updater_state, net.state))
+    params, opt, state, _, _ = step(net.params, net.updater_state, net.state,
+                                    rng, jnp.float32(0), xd, yd, None, None,
+                                    {})
     _sync(params)  # compile + warm
     n1, n2 = steps, 2 * steps
-    t1, _ = run(n1, params, opt, state)
-    t2, loss = run(n2, params, opt, state)
+    t1, _ = run(n1, params0, opt0, state0)
+    t2, loss = run(n2, params0, opt0, state0)
     assert bool(jnp.isfinite(loss)), "non-finite loss in benchmark"
     per_step = max((t2 - t1) / (n2 - n1), 1e-9)
     return x.shape[0] / per_step
@@ -109,6 +124,40 @@ def bench_lstm(batch: int = 64, seq: int = 50, vocab: int = 77,
     return _steady_state_img_s(net, x, y, steps) * seq
 
 
+def bench_attention(B: int = 4, H: int = 8, T: int = 4096, d: int = 128,
+                    steps: int = 30):
+    """Pallas flash-attention kernel vs stock XLA attention (the
+    accelerated-kernel stage, SURVEY §7 stage 4). Chained serial timing:
+    each call consumes the previous output, so queue pipelining cannot hide
+    per-call latency. Returns (stock_ms, flash_ms)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.conf.layers.attention import (
+        scaled_dot_attention,
+    )
+    from deeplearning4j_tpu.ops.pallas_attention import flash_attention
+
+    rs = np.random.RandomState(7)
+    q0 = jnp.asarray(rs.randn(B, H, T, d), jnp.float32)
+    k = jnp.asarray(rs.randn(B, H, T, d), jnp.float32)
+    v = jnp.asarray(rs.randn(B, H, T, d), jnp.float32)
+
+    def chained_ms(f):
+        _sync(f(q0, k, v))  # compile + warm
+        t0 = time.perf_counter()
+        o = q0
+        for _ in range(steps):
+            o = f(o, k, v)
+        _sync(o)
+        return (time.perf_counter() - t0) / steps * 1000
+
+    stock = jax.jit(lambda q, k, v: scaled_dot_attention(q, k, v,
+                                                         causal=True))
+    flash = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+    return chained_ms(stock), chained_ms(flash)
+
+
 def bench_word2vec(n_sentences: int = 2000, epochs: int = 1):
     """SkipGram words/s on a synthetic corpus (BASELINE config #4)."""
     from deeplearning4j_tpu.nlp import CollectionSentenceIterator, Word2Vec
@@ -138,7 +187,7 @@ def bench_word2vec(n_sentences: int = 2000, epochs: int = 1):
 
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
-    valid = ("all", "resnet50", "lenet", "lstm", "word2vec")
+    valid = ("all", "resnet50", "lenet", "lstm", "word2vec", "attention")
     if which not in valid:
         sys.exit(f"Unknown model '{which}'; choose one of {valid}")
     extras = {}
@@ -152,6 +201,14 @@ def main():
     if which in ("all", "word2vec"):
         extras["word2vec_words_s"] = round(bench_word2vec(), 1)
         print(f"# word2vec {extras['word2vec_words_s']} words/s",
+              file=sys.stderr)
+    if which in ("all", "attention"):
+        stock_ms, flash_ms = bench_attention()
+        extras["attention_t4096_stock_ms"] = round(stock_ms, 3)
+        extras["attention_t4096_flash_ms"] = round(flash_ms, 3)
+        extras["attention_flash_speedup"] = round(stock_ms / flash_ms, 3)
+        print(f"# attention T=4096 stock {stock_ms:.2f} ms, flash "
+              f"{flash_ms:.2f} ms ({stock_ms / flash_ms:.2f}x)",
               file=sys.stderr)
     if which in ("all", "resnet50"):
         extras["resnet50_bf16_img_s"] = round(

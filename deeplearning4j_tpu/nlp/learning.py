@@ -95,59 +95,105 @@ def skipgram_step(syn0, syn1, syn1neg, centers, points, codes, code_mask,
     return syn0, syn1, syn1neg
 
 
-@partial(jax.jit, static_argnames=("use_hs", "use_ns"),
+@partial(jax.jit,
+         static_argnames=("window", "batch", "neg_k", "use_hs", "use_ns"),
          donate_argnums=(0, 1, 2))
-def skipgram_epoch(syn0, syn1, syn1neg, centers, points, codes, code_mask,
-                   neg_targets, neg_labels, pair_mask, lrs, dup_cap, *,
-                   use_hs: bool, use_ns: bool):
-    """A whole epoch of skipgram updates as ONE device program.
+def skipgram_corpus_epoch(syn0, syn1, syn1neg, tokens, key,
+                          lr_start, lr_end, dup_cap, points_tab, codes_tab,
+                          cmask_tab, neg_table, *, window: int, batch: int,
+                          neg_k: int, use_hs: bool, use_ns: bool):
+    """One skipgram epoch generated AND trained on device.
 
-    The reference's hot loop is a native per-pair op dispatched from Java
-    threads (SkipGram.java:271-272 AggregateSkipGram); the round-2 TPU port
-    still paid one host->device dispatch per 8k-pair batch, which capped
-    throughput at ~7k words/s. Here every batch of the epoch is pre-staged
-    on device and a ``lax.scan`` applies them back-to-back — zero host
-    round-trips inside the epoch, donated syn buffers, same math as
-    ``skipgram_step`` plus a per-pair validity mask for padding.
+    The round-3 v1 fast path staged pre-built pair/negative batches from
+    host, but the host->device link is the scarce resource (the reference's
+    AggregateSkipGram runs host-side so never pays it): ~25 bytes/pair of
+    wire traffic capped throughput far below device speed. This kernel
+    uploads only the TOKEN STREAM (4 bytes/token + sentence ids) and derives
+    everything else on device:
 
-    centers: [S, B]; points/codes/code_mask: [S, B, L];
-    neg_targets/neg_labels: [S, B, 1+K]; pair_mask: [S, B] (0 = padding);
-    lrs: [S] per-batch learning rate (linear decay precomputed on host).
+    - pairs: per-offset shifted views of the padded token stream, validity =
+      same sentence AND |offset| <= per-position random window
+      (win = window - rand % window, the reference's shrinking window),
+      laid out corpus-ordered [N, 2W] -> [S, B];
+    - negatives: unigram^0.75 table lookups with jax.random, per batch;
+    - HS paths: gathers from device-resident [V, L] huffman tables;
+    - LR: linear lr_start -> lr_end across the S batches.
+
+    tokens: [N] int32 stream with -1 as sentence separator AND tail
+    padding, sized so N*2W % batch == 0 (separator/padding positions
+    produce pair_mask 0; sentence ids are a device-side cumsum over the
+    separators). Per-batch update math matches ``skipgram_step`` (same
+    dup-cap stabilisation).
     """
-
+    N = tokens.shape[0]
+    W = window
+    kw, kn = jax.random.split(key)
+    win = jax.random.randint(kw, (N,), 1, W + 1, dtype=jnp.int32)
+    sent_id = jnp.cumsum((tokens < 0).astype(jnp.int32))
+    tok_pad = jnp.pad(tokens, W, constant_values=-1)
+    sid_pad = jnp.pad(sent_id, W, constant_values=-2)
+    ctxs, valids = [], []
+    for d in range(-W, W + 1):
+        if d == 0:
+            continue
+        ctx_d = jax.lax.dynamic_slice(tok_pad, (W + d,), (N,))
+        sid_d = jax.lax.dynamic_slice(sid_pad, (W + d,), (N,))
+        valids.append((sid_d == sent_id) & (jnp.abs(d) <= win)
+                      & (tokens >= 0) & (ctx_d >= 0))
+        ctxs.append(ctx_d)
+    ctx = jnp.stack(ctxs, 1)                       # [N, 2W] corpus order
+    val = jnp.stack(valids, 1)
+    P = N * 2 * W
+    S = P // batch
+    # rows that move = context words; predicted = centers (reference
+    # SkipGram iterateSample(currentWord=center, lastWord=context)
+    # updates syn0[lastWord])
+    rows = jnp.maximum(ctx, 0).reshape(S, batch)
+    pred = jnp.broadcast_to(tokens[:, None], ctx.shape)
+    pred = jnp.maximum(pred, 0).reshape(S, batch)
+    pm = val.reshape(S, batch).astype(syn0.dtype)
+    lrs = jnp.linspace(lr_start, lr_end, S).astype(syn0.dtype)
     V = syn0.shape[0]
+    tsize = neg_table.shape[0]
 
     def body(carry, xs):
         syn0, syn1, syn1neg = carry
-        c, p, cd, cm, nt, nl, pm, lr = xs
+        c, p_idx, pm_b, lr, i = xs
         h = syn0[c]
         grad_h = jnp.zeros_like(h)
         if use_hs:
-            w1 = syn1[p]
+            pts = points_tab[p_idx]                # [B, L]
+            cd = codes_tab[p_idx]
+            cm = cmask_tab[p_idx] * pm_b[:, None]
+            w1 = syn1[pts]
             f = jax.nn.sigmoid(jnp.einsum("bd,bld->bl", h, w1))
-            g = (1.0 - cd - f) * cm * pm[:, None] * lr
+            g = (1.0 - cd - f) * cm * lr
             grad_h = grad_h + jnp.einsum("bl,bld->bd", g, w1)
-            s1 = _row_mean_scale(V, p, cm * pm[:, None], dup_cap)
-            syn1 = syn1.at[p].add(jnp.einsum("bl,bd->bld", g, h)
-                                  * s1[..., None])
+            s1 = _row_mean_scale(V, pts, cm, dup_cap)
+            syn1 = syn1.at[pts].add(jnp.einsum("bl,bd->bld", g, h)
+                                    * s1[..., None])
         if use_ns:
+            draws = jax.random.randint(jax.random.fold_in(kn, i),
+                                       (batch, neg_k), 0, tsize,
+                                       dtype=jnp.int32)
+            nt = jnp.concatenate([p_idx[:, None], neg_table[draws]], axis=1)
+            nl = jnp.zeros((batch, 1 + neg_k), syn0.dtype).at[:, 0].set(1.0)
             wn = syn1neg[nt]
             f = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", h, wn))
-            g = (nl - f) * pm[:, None] * lr
+            g = (nl - f) * pm_b[:, None] * lr
             grad_h = grad_h + jnp.einsum("bk,bkd->bd", g, wn)
             sn = _row_mean_scale(V, nt,
-                                 jnp.broadcast_to(pm[:, None], nt.shape),
+                                 jnp.broadcast_to(pm_b[:, None], nt.shape),
                                  dup_cap)
             syn1neg = syn1neg.at[nt].add(jnp.einsum("bk,bd->bkd", g, h)
                                          * sn[..., None])
-        s0 = _row_mean_scale(V, c, pm, dup_cap)
+        s0 = _row_mean_scale(V, c, pm_b, dup_cap)
         syn0 = syn0.at[c].add(grad_h * s0[:, None])
         return (syn0, syn1, syn1neg), None
 
     (syn0, syn1, syn1neg), _ = jax.lax.scan(
         body, (syn0, syn1, syn1neg),
-        (centers, points, codes, code_mask, neg_targets, neg_labels,
-         pair_mask, lrs))
+        (rows, pred, pm, lrs, jnp.arange(S, dtype=jnp.int32)))
     return syn0, syn1, syn1neg
 
 

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -25,7 +26,7 @@ from deeplearning4j_tpu.nlp.learning import (
     DUP_CAP,
     BatchBuilder,
     cbow_step,
-    skipgram_epoch,
+    skipgram_corpus_epoch,
     skipgram_step,
 )
 from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
@@ -122,24 +123,21 @@ class SequenceVectors:
         return self
 
     def _fit_skipgram_epochs(self, sentences) -> "SequenceVectors":
-        """Device-resident skipgram training: tokenize once, generate every
-        (center, context) pair of an epoch in one vectorised host pass
-        (``BatchBuilder.pairs_from_corpus``), pad to [S, batch_size], and run
-        ONE jitted ``lax.scan`` per epoch (``skipgram_epoch``). Epochs share
-        a padded batch count so the program compiles once.
+        """Device-resident skipgram training, transfer-minimal: the host
+        uploads only the TOKEN STREAM (4 bytes/token, -1 sentence
+        separators); pair generation, negative sampling, huffman-path
+        gathers, and the whole batched update scan run inside ONE jitted
+        program per corpus block (``skipgram_corpus_epoch``). Rationale:
+        staging pre-built pair batches costs ~25 bytes/pair over the
+        host->device link and was the measured round-3 bottleneck.
 
-        Pair order is shuffled within an epoch (the per-offset vectorised
-        generation already abandons strict corpus order; a permutation
-        decorrelates batches). LR decays linearly over batches to
-        min_learning_rate, matching the reference's words-seen decay."""
+        Blocks of ~BLOCK_TOKENS bound device/host memory; token streams are
+        padded to power-of-two buckets so compile count stays logarithmic.
+        LR decays linearly over the whole run to min_learning_rate
+        (reference: words-seen decay)."""
         b = self._builder
         if hasattr(sentences, "reset"):
             sentences.reset()
-        # Tokenize + vocab-index once (no subsampling yet); group sentences
-        # into blocks of ~BLOCK_TOKENS so pair arrays are generated
-        # streaming per block, not for the whole corpus at once — host
-        # memory stays O(block), a 100M-token corpus never materialises
-        # tens of GB of pairs.
         BLOCK_TOKENS = 1 << 21
         blocks, cur, cur_tokens, total_tokens = [], [], 0, 0
         for sentence in sentences:
@@ -156,92 +154,69 @@ class SequenceVectors:
                 cur, cur_tokens = [], 0
         if cur:
             blocks.append(cur)
-        B = self.batch_size
-        chunk = 128  # max scan batches per dispatch (bounds staging memory)
-        done, n_total = 0, 0
+        if not blocks:
+            return self
+        B, W, K = self.batch_size, self.window, self.negative
+        L = b.max_code_len
+        # device-resident lookup tables, uploaded once per fit
+        if self.use_hs:
+            points_tab = jnp.asarray(b.points)
+            codes_tab = jnp.asarray(b.codes)
+            cmask_tab = jnp.asarray(b.code_mask)
+        else:
+            points_tab = jnp.zeros((1, 1), jnp.int32)
+            codes_tab = jnp.zeros((1, 1), jnp.float32)
+            cmask_tab = jnp.zeros((1, 1), jnp.float32)
+        neg_table = (jnp.asarray(b._neg_table) if K > 0
+                     else jnp.zeros((1,), jnp.int32))
+        total_units = max(total_tokens * self.epochs * self.iterations, 1)
+        done = 0
         for e in range(self.epochs):
-            for bi, block in enumerate(blocks):
-                # fresh subsampling draw and dynamic windows per epoch
-                # (reference resamples both every pass over the corpus)
-                cs, xs = [], []
-                for _ in range(self.iterations):
-                    # fresh subsampling draw and dynamic windows per
-                    # iteration and epoch (reference resamples both on
-                    # every pass over the corpus)
+            for block in blocks:
+                for it in range(self.iterations):
+                    # fresh subsampling draw per pass (reference resamples
+                    # every epoch/iteration); dynamic windows are drawn on
+                    # device from the per-call rng key
                     sent_idx = [b.subsample(sid) for sid in block] \
                         if self.sampling > 0 else block
-                    ci, xi = b.pairs_from_corpus(sent_idx)
-                    cs.append(ci)
-                    xs.append(xi)
-                centers = np.concatenate(cs)
-                contexts = np.concatenate(xs)
-                if not centers.size:
-                    continue
-                perm = b.rng.permutation(centers.size)
-                centers, contexts = centers[perm], contexts[perm]
-                if n_total == 0:
-                    # LR-schedule denominator, set at the first non-empty
-                    # block: pairs per RAW token (subsampling ratio folds
-                    # in automatically) extrapolated over the corpus;
-                    # progress is clamped to 1 in _skipgram_dispatch
-                    per_tok = centers.size / max(
-                        sum(sid.size for sid in block), 1)
-                    n_total = max(int(per_tok * total_tokens) * self.epochs,
-                                  1)
-                off = 0
-                while off < centers.size:
-                    take = min(chunk * B, centers.size - off)
-                    done = self._skipgram_dispatch(
-                        centers[off:off + take], contexts[off:off + take],
-                        done, n_total)
-                    off += take
+                    stream = self._token_stream(sent_idx, B, W)
+                    if stream is None:
+                        continue
+                    raw = sum(sid.size for sid in block)
+                    lr0 = self._alpha(min(done / total_units, 1.0))
+                    lr1 = self._alpha(min((done + raw) / total_units, 1.0))
+                    key = jax.random.fold_in(
+                        jax.random.PRNGKey(self.seed + 1),
+                        done + e * 131071 + it)
+                    self.syn0, self.syn1, self.syn1neg = \
+                        skipgram_corpus_epoch(
+                            self.syn0, self.syn1, self.syn1neg,
+                            stream, key, jnp.float32(lr0),
+                            jnp.float32(lr1), jnp.float32(DUP_CAP),
+                            points_tab, codes_tab, cmask_tab, neg_table,
+                            window=W, batch=B, neg_k=max(K, 0),
+                            use_hs=self.use_hs, use_ns=K > 0)
+                    done += raw
         return self
 
-    def _skipgram_dispatch(self, centers, contexts, done, n_total) -> int:
-        """Stage one chunk of pairs as [S, B] device arrays and run the
-        jitted epoch scan. S is padded to a power of two so at most
-        log2(chunk)+1 program shapes ever compile."""
-        b, B = self._builder, self.batch_size
-        P, L, K = centers.size, b.max_code_len, self.negative
-        S = 1
-        while S * B < P:
-            S *= 2
-        pad = S * B - P
-        # predicted word = center (its huffman path / NS positive); the syn0
-        # row that moves = context (reference SkipGram iterateSample
-        # (currentWord=center, lastWord=context) updates syn0[lastWord])
-        rows = np.concatenate([contexts, np.zeros(pad, np.int32)])
-        pred = np.concatenate([centers, np.zeros(pad, np.int32)])
-        mask = np.concatenate([np.ones(P, np.float32),
-                               np.zeros(pad, np.float32)])
-        if self.use_hs:
-            points = b.points[pred].reshape(S, B, L)
-            codes = b.codes[pred].reshape(S, B, L)
-            cmask = b.code_mask[pred].reshape(S, B, L)
-        else:  # dummy single-level arrays keep the jit signature static
-            points = np.zeros((S, B, 1), np.int32)
-            codes = np.zeros((S, B, 1), np.float32)
-            cmask = np.zeros((S, B, 1), np.float32)
-        if K > 0:
-            negs = b.sample_negatives(pred).reshape(S, B, 1 + K)
-            nlab = np.zeros((S, B, 1 + K), np.float32)
-            nlab[..., 0] = 1.0
-        else:
-            negs = np.zeros((S, B, 1), np.int32)
-            nlab = np.zeros((S, B, 1), np.float32)
-        # linear LR decay by global pair progress (reference: alpha by words
-        # seen), floored at min_learning_rate
-        prog = np.minimum((done + np.arange(S) * B) / n_total, 1.0)
-        lrs = np.maximum(self.min_learning_rate,
-                         self.learning_rate * (1.0 - prog)).astype(np.float32)
-        self.syn0, self.syn1, self.syn1neg = skipgram_epoch(
-            self.syn0, self.syn1, self.syn1neg,
-            jnp.asarray(rows.reshape(S, B)),
-            jnp.asarray(points), jnp.asarray(codes), jnp.asarray(cmask),
-            jnp.asarray(negs), jnp.asarray(nlab),
-            jnp.asarray(mask.reshape(S, B)), jnp.asarray(lrs),
-            jnp.float32(DUP_CAP), use_hs=self.use_hs, use_ns=K > 0)
-        return done + P
+    @staticmethod
+    def _token_stream(sent_idx, batch: int, window: int):
+        """Concatenate sentences with -1 separators, pad with -1 to the
+        smallest power-of-two N >= batch with N*2W % batch == 0 (bounds the
+        number of compiled program shapes)."""
+        parts = []
+        for sid in sent_idx:
+            if sid.size:
+                parts.append(sid.astype(np.int32))
+                parts.append(np.full(1, -1, np.int32))
+        if not parts:
+            return None
+        stream = np.concatenate(parts)
+        n = max(int(batch), 2)
+        while n < stream.size or (n * 2 * window) % batch:
+            n *= 2
+        return jnp.asarray(np.concatenate(
+            [stream, np.full(n - stream.size, -1, np.int32)]))
 
     def _alpha(self, progress: float) -> float:
         return max(self.min_learning_rate,

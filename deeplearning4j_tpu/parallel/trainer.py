@@ -133,7 +133,8 @@ class ParallelWrapper:
                       batch_spec, batch_spec, batch_spec, batch_spec),
             out_specs=(P(), P(), P(), P()),
             check_vma=False)
-        return jax.jit(fn)
+        # params/opt/state are rebound from the round's outputs
+        return jax.jit(fn, donate_argnums=(0, 1, 2))
 
     def _get_round(self, key):
         if key not in self._round_cache:

@@ -1,0 +1,113 @@
+"""Accelerated-kernel (Pallas flash attention) vs stock-XLA parity.
+
+Ports the reference's helper-vs-stock test pattern
+(deeplearning4j-cuda/src/test/: cuDNN helper output must equal the pure
+ND4J layer output) to the TPU build's one accelerated kernel: the
+flash-attention forward (ops/pallas_attention.py) behind
+SelfAttentionLayer's ``helper`` switch. On the CPU test mesh the kernel
+runs in interpreter mode; the driver's TPU bench measures the speedup
+(bench.py bench_attention).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.layers.attention import (
+    SelfAttentionLayer,
+    scaled_dot_attention,
+)
+from deeplearning4j_tpu.ops.pallas_attention import flash_attention, supports
+
+
+def _qkv(B=2, H=3, T=256, d=64, seed=0):
+    rs = np.random.RandomState(seed)
+    return tuple(jnp.asarray(rs.randn(B, H, T, d), jnp.float32)
+                 for _ in range(3))
+
+
+class TestFlashAttentionKernel:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_stock(self, causal):
+        q, k, v = _qkv()
+        ref = scaled_dot_attention(q, k, v, causal=causal)
+        out = flash_attention(q, k, v, causal=causal, block_q=128,
+                              block_k=128)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_gradients_match_stock(self, causal):
+        q, k, v = _qkv(T=128, d=32)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(scaled_dot_attention(q, k, v, causal=causal) ** 2)
+
+        def loss_new(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=causal) ** 2)
+
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        g_new = jax.grad(loss_new, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_ref, g_new):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4, rtol=1e-4)
+
+    def test_uneven_q_k_blocks_causal(self):
+        # block_q != block_k exercises the diagonal-block arithmetic
+        q, k, v = _qkv(T=256)
+        ref = scaled_dot_attention(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True, block_q=128, block_k=64)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_blocks_clamp_to_short_sequences(self):
+        q, k, v = _qkv(T=64)
+        ref = scaled_dot_attention(q, k, v)
+        out = flash_attention(q, k, v)  # default blocks 512 -> clamped
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_supports_gating(self):
+        assert supports((2, 3, 256, 64), mask=None)
+        assert supports((2, 3, 250, 64), mask=None)  # clamps to one block
+        # larger than a block but not divisible -> stock fallback
+        assert not supports((2, 3, 600, 64), mask=None)
+        assert not supports((2, 3, 256, 64), mask=np.ones((2, 256)))
+
+
+class TestSelfAttentionHelperSwitch:
+    def _layer(self, helper, causal=False):
+        lyr = SelfAttentionLayer(n_in=32, n_out=32, n_heads=4,
+                                 causal=causal, helper=helper,
+                                 bias_init=0.0)
+        params = lyr.init_params(jax.random.PRNGKey(0))
+        return lyr, params
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_pallas_equals_stock(self, causal):
+        rs = np.random.RandomState(1)
+        x = jnp.asarray(rs.randn(2, 128, 32), jnp.float32)
+        l_stock, p = self._layer("stock", causal)
+        l_pallas, _ = self._layer("pallas", causal)
+        out_s, _ = l_stock.forward(p, {}, x)
+        out_p, _ = l_pallas.forward(p, {}, x)
+        np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_s),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_auto_falls_back_on_mask(self):
+        rs = np.random.RandomState(2)
+        x = jnp.asarray(rs.randn(2, 64, 32), jnp.float32)
+        mask = jnp.ones((2, 64), jnp.float32).at[:, 40:].set(0.0)
+        l_auto, p = self._layer("auto")
+        l_stock, _ = self._layer("stock")
+        out_a, _ = l_auto.forward(p, {}, x, mask=mask)
+        out_s, _ = l_stock.forward(p, {}, x, mask=mask)
+        np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_s),
+                                   atol=1e-6)
+
+    def test_pallas_with_mask_raises(self):
+        l, p = self._layer("pallas")
+        x = jnp.zeros((2, 64, 32), jnp.float32)
+        with pytest.raises(ValueError, match="key mask"):
+            l.forward(p, {}, x, mask=jnp.ones((2, 64)))
