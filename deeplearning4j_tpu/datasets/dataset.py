@@ -17,7 +17,7 @@ class DataSet:
 
     def __init__(self, features, labels, features_mask=None, labels_mask=None):
         self.features = np.asarray(features)
-        self.labels = np.asarray(labels)
+        self.labels = None if labels is None else np.asarray(labels)
         self.features_mask = None if features_mask is None else np.asarray(features_mask)
         self.labels_mask = None if labels_mask is None else np.asarray(labels_mask)
 
@@ -25,18 +25,22 @@ class DataSet:
         return int(self.features.shape[0])
 
     def split_test_and_train(self, n_train: int):
-        return (DataSet(self.features[:n_train], self.labels[:n_train],
-                        None if self.features_mask is None else self.features_mask[:n_train],
-                        None if self.labels_mask is None else self.labels_mask[:n_train]),
-                DataSet(self.features[n_train:], self.labels[n_train:],
-                        None if self.features_mask is None else self.features_mask[n_train:],
-                        None if self.labels_mask is None else self.labels_mask[n_train:]))
+        def cut(a, sl):
+            return None if a is None else a[sl]
+
+        return (DataSet(self.features[:n_train], cut(self.labels, slice(None, n_train)),
+                        cut(self.features_mask, slice(None, n_train)),
+                        cut(self.labels_mask, slice(None, n_train))),
+                DataSet(self.features[n_train:], cut(self.labels, slice(n_train, None)),
+                        cut(self.features_mask, slice(n_train, None)),
+                        cut(self.labels_mask, slice(n_train, None))))
 
     def shuffle(self, seed: Optional[int] = None):
         rng = np.random.default_rng(seed)
         idx = rng.permutation(self.num_examples())
         self.features = self.features[idx]
-        self.labels = self.labels[idx]
+        if self.labels is not None:
+            self.labels = self.labels[idx]
         if self.features_mask is not None:
             self.features_mask = self.features_mask[idx]
         if self.labels_mask is not None:
@@ -46,7 +50,8 @@ class DataSet:
         n = self.num_examples()
         for s in range(0, n, batch_size):
             sl = slice(s, min(s + batch_size, n))
-            yield DataSet(self.features[sl], self.labels[sl],
+            yield DataSet(self.features[sl],
+                          None if self.labels is None else self.labels[sl],
                           None if self.features_mask is None else self.features_mask[sl],
                           None if self.labels_mask is None else self.labels_mask[sl])
 
@@ -54,7 +59,8 @@ class DataSet:
     def merge(datasets):
         return DataSet(
             np.concatenate([d.features for d in datasets]),
-            np.concatenate([d.labels for d in datasets]),
+            None if datasets[0].labels is None
+            else np.concatenate([d.labels for d in datasets]),
             None if datasets[0].features_mask is None
             else np.concatenate([d.features_mask for d in datasets]),
             None if datasets[0].labels_mask is None

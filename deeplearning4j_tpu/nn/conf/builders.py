@@ -212,6 +212,8 @@ class NeuralNetConfiguration:
     l1_bias: Optional[float] = None
     l2_bias: Optional[float] = None
     dropout: Optional[float] = None
+    gradient_normalization: Optional[str] = None
+    gradient_normalization_threshold: Optional[float] = None
     updater: Updater = field(default_factory=lambda: Sgd(learning_rate=0.1))
     dtype: str = "float32"
     compute_dtype: Optional[str] = None
@@ -259,6 +261,19 @@ class NeuralNetConfigurationBuilder:
 
     def dropout(self, v: float):
         self._c.dropout = float(v)
+        return self
+
+    def gradient_normalization(self, mode: str):
+        from deeplearning4j_tpu.nn.gradient_normalization import MODES
+        m = str(mode).lower()
+        if m not in MODES:
+            raise ValueError(f"Unknown gradient_normalization '{mode}'; "
+                             f"choose one of {MODES}")
+        self._c.gradient_normalization = m
+        return self
+
+    def gradient_normalization_threshold(self, t: float):
+        self._c.gradient_normalization_threshold = float(t)
         return self
 
     def updater(self, u, learning_rate: Optional[float] = None):

@@ -32,7 +32,10 @@ from deeplearning4j_tpu.nn.conf.builders import (
 )
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers.base import Layer
-from deeplearning4j_tpu.nn.conf.preprocessors import InputPreProcessor
+from deeplearning4j_tpu.nn.conf.preprocessors import (
+    InputPreProcessor,
+    preprocessor_key,
+)
 from deeplearning4j_tpu.nn.updater import Sgd, Updater
 from deeplearning4j_tpu.utils import serde
 from deeplearning4j_tpu.utils.serde import register_serializable
@@ -128,7 +131,7 @@ class LayerVertex(GraphVertex):
         x = inputs[0]
         mask = masks[0] if masks else None
         if self.preprocessor is not None:
-            x = self.preprocessor.forward(x)
+            x = self.preprocessor.forward(x, rng=preprocessor_key(rng))
             mask = self.preprocessor.feed_forward_mask(mask)
         return self.layer.forward(params, state, x, mask=mask, train=train,
                                   rng=rng)
@@ -316,7 +319,9 @@ class PreprocessorVertex(GraphVertex):
 
     def forward(self, params, state, inputs, *, masks=None, ctx=None,
                 train=False, rng=None):
-        return self.preprocessor.forward(inputs[0]), state
+        return (self.preprocessor.forward(inputs[0],
+                                          rng=preprocessor_key(rng)),
+                state)
 
     def feed_forward_mask(self, masks):
         return self.preprocessor.feed_forward_mask(masks[0] if masks else None)

@@ -53,3 +53,4 @@ from deeplearning4j_tpu.nn.conf.layers.misc import (
     FrozenLayer,
     CenterLossOutputLayer,
 )
+from deeplearning4j_tpu.nn.conf.layers.rbm import RBM

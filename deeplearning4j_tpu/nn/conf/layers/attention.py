@@ -102,7 +102,8 @@ class SelfAttentionLayer(BaseLayer):
         if self.helper not in ("auto", "pallas", "stock"):
             raise ValueError(f"Unknown helper '{self.helper}'")
         use_pallas = self.helper == "pallas" or (
-            self.helper == "auto" and pa.supports(q.shape, mask=mask))
+            self.helper == "auto"
+            and pa.supports(q.shape, mask=mask, dtype=q.dtype))
         if use_pallas:
             if mask is not None:
                 raise ValueError(

@@ -39,6 +39,14 @@ class Layer:
 
     name: Optional[str] = None
     dropout: Optional[float] = None
+    # Gradient normalization/clipping applied between backprop and the
+    # updater (reference: nn/conf/GradientNormalization.java, applied in
+    # BaseMultiLayerUpdater.preApply :310-352). Modes: none |
+    # renormalize_l2_per_layer | renormalize_l2_per_param_type |
+    # clip_element_wise_absolute_value | clip_l2_per_layer |
+    # clip_l2_per_param_type
+    gradient_normalization: Optional[str] = None
+    gradient_normalization_threshold: Optional[float] = None
 
     # what array kind this layer consumes: ff | cnn | rnn | any
     INPUT_KIND = "any"
@@ -49,6 +57,16 @@ class Layer:
         if self.dropout is None:
             self.dropout = (g.dropout if g is not None and g.dropout is not None
                             else 0.0)
+        if self.gradient_normalization is None:
+            self.gradient_normalization = (
+                g.gradient_normalization
+                if g is not None and g.gradient_normalization is not None
+                else "none")
+        if self.gradient_normalization_threshold is None:
+            self.gradient_normalization_threshold = (
+                g.gradient_normalization_threshold
+                if g is not None
+                and g.gradient_normalization_threshold is not None else 1.0)
 
     def output_type(self, input_type: InputType) -> InputType:
         return input_type

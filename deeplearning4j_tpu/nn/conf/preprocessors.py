@@ -1,23 +1,45 @@
-"""Input preprocessors: shape adapters auto-inserted between layer kinds.
+"""Input preprocessors: shape adapters auto-inserted between layer kinds,
+plus the statistical preprocessors (normalizers / binomial sampling).
 
-Reference: nn/conf/preprocessor/* (CnnToFeedForward, FeedForwardToCnn, RnnToFeedForward,
-FeedForwardToRnn, CnnToRnn, RnnToCnn). With autodiff, only the forward reshape is
-needed — jax derives the backward reshape. Layouts: NHWC, [B,T,F].
+Reference: nn/conf/preprocessor/* — all 12: the 6 shape adapters
+(CnnToFeedForward, FeedForwardToCnn, RnnToFeedForward, FeedForwardToRnn,
+CnnToRnn, RnnToCnn), the 3 per-batch normalizers (ZeroMeanPrePreProcessor,
+UnitVarianceProcessor, ZeroMeanAndUnitVariancePreProcessor), stochastic
+BinomialSamplingPreProcessor, ComposableInputPreProcessor, and the Base
+abstract (here ``InputPreProcessor``). With autodiff, only the forward is
+needed — jax derives the backward reshape; the normalizers stop_gradient
+their batch statistics to match the reference's pass-through ``backprop``
+(BaseInputPreProcessor subclasses return the epsilon unchanged). Layouts:
+NHWC, [B,T,F].
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
+import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.utils.serde import register_serializable
 
+_EPS = 1e-5  # Nd4j.EPS_THRESHOLD analog for the variance normalizers
+
+
+def preprocessor_key(rng):
+    """Derive the key a stochastic preprocessor may consume from a key that
+    is ALSO driving the layer behind it. A preprocessor must never draw
+    with its layer's own key (the same uniforms would couple e.g. the
+    binarization to the dropout mask) — every call site that holds one key
+    for both derives the preprocessor's via this single fold so paths that
+    must agree (a vertex's forward and the graph's loss-input collection)
+    stay bit-identical."""
+    return None if rng is None else jax.random.fold_in(rng, 0x9E37)
+
 
 @dataclass
 class InputPreProcessor:
-    def forward(self, x):
+    def forward(self, x, rng=None):
         raise NotImplementedError
 
     def output_type(self, input_type: InputType) -> InputType:
@@ -36,7 +58,7 @@ class CnnToFeedForwardPreProcessor(InputPreProcessor):
     width: int = 0
     channels: int = 0
 
-    def forward(self, x):
+    def forward(self, x, rng=None):
         return x.reshape(x.shape[0], -1)
 
     def output_type(self, input_type: InputType) -> InputType:
@@ -52,7 +74,7 @@ class FeedForwardToCnnPreProcessor(InputPreProcessor):
     width: int = 0
     channels: int = 0
 
-    def forward(self, x):
+    def forward(self, x, rng=None):
         return x.reshape(x.shape[0], self.height, self.width, self.channels)
 
     def output_type(self, input_type: InputType) -> InputType:
@@ -61,11 +83,111 @@ class FeedForwardToCnnPreProcessor(InputPreProcessor):
 
 @register_serializable
 @dataclass
+class ZeroMeanPrePreProcessor(InputPreProcessor):
+    """Subtract per-column batch means (reference:
+    ZeroMeanPrePreProcessor.java; backprop there is pass-through, so the
+    statistics are constants — stop_gradient reproduces that exactly)."""
+
+    def forward(self, x, rng=None):
+        return x - jax.lax.stop_gradient(jnp.mean(x, axis=0, keepdims=True))
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+
+@register_serializable
+@dataclass
+class UnitVarianceProcessor(InputPreProcessor):
+    """Divide by per-column batch std + eps (reference:
+    UnitVarianceProcessor.java:40-44)."""
+
+    def forward(self, x, rng=None):
+        if x.shape[0] < 2:
+            return x  # ddof=1 std is undefined (0/0) for a single example
+        std = jnp.std(x, axis=0, keepdims=True, ddof=1) + _EPS
+        return x / jax.lax.stop_gradient(std)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+
+@register_serializable
+@dataclass
+class ZeroMeanAndUnitVariancePreProcessor(InputPreProcessor):
+    """Standardize per column over the batch (reference:
+    ZeroMeanAndUnitVariancePreProcessor.java:39-45)."""
+
+    def forward(self, x, rng=None):
+        mean = jnp.mean(x, axis=0, keepdims=True)
+        x = x - jax.lax.stop_gradient(mean)
+        if x.shape[0] < 2:
+            return x  # ddof=1 std is undefined (0/0) for a single example
+        std = jnp.std(x, axis=0, keepdims=True, ddof=1) + _EPS
+        return x / jax.lax.stop_gradient(std)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+
+@register_serializable
+@dataclass
+class BinomialSamplingPreProcessor(InputPreProcessor):
+    """Bernoulli-sample activations as probabilities (reference:
+    BinomialSamplingPreProcessor.java:37-39 — the RBM-stack binarizer;
+    backprop there is pass-through == the straight-through estimator here).
+
+    The reference draws from a global RNG; here sampling is deterministic
+    per ``seed`` (functional purity — the same jitted program must be
+    replayable), which also makes it testable.
+    """
+
+    seed: int = 0
+
+    def forward(self, x, rng=None):
+        # training passes the per-step rng (fresh samples each step, like
+        # the reference's global RNG); without one, fall back to a
+        # deterministic per-seed key (pure inference/replay)
+        key = jax.random.PRNGKey(self.seed) if rng is None else rng
+        sample = jax.random.bernoulli(key, x).astype(x.dtype)
+        return x + jax.lax.stop_gradient(sample - x)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+
+@register_serializable
+@dataclass
+class ComposableInputPreProcessor(InputPreProcessor):
+    """Chain preprocessors in order (reference:
+    ComposableInputPreProcessor.java — preProcess applies in order,
+    backprop in reverse; autodiff gives the reverse order for free)."""
+
+    processors: list = field(default_factory=list)
+
+    def forward(self, x, rng=None):
+        for i, p in enumerate(self.processors):
+            x = p.forward(x, rng=None if rng is None
+                          else jax.random.fold_in(rng, i))
+        return x
+
+    def output_type(self, input_type: InputType) -> InputType:
+        for p in self.processors:
+            input_type = p.output_type(input_type)
+        return input_type
+
+    def feed_forward_mask(self, mask):
+        for p in self.processors:
+            mask = p.feed_forward_mask(mask)
+        return mask
+
+
+@register_serializable
+@dataclass
 class RnnToFeedForwardPreProcessor(InputPreProcessor):
     """[B,T,F] -> [B*T,F] (reference reshapes 3d->2d for dense layers; our dense
     layers broadcast over time natively, so this is only used when explicitly set)."""
 
-    def forward(self, x):
+    def forward(self, x, rng=None):
         return x.reshape(-1, x.shape[-1])
 
     def output_type(self, input_type: InputType) -> InputType:
@@ -78,7 +200,7 @@ class FeedForwardToRnnPreProcessor(InputPreProcessor):
     """[B*T,F] -> [B,T,F]. Needs the time length at call sites; with static shapes we
     instead expand a plain [B,F] to [B,1,F]."""
 
-    def forward(self, x):
+    def forward(self, x, rng=None):
         return x[:, None, :]
 
     def output_type(self, input_type: InputType) -> InputType:
@@ -96,7 +218,7 @@ class CnnToRnnPreProcessor(InputPreProcessor):
     width: int = 0
     channels: int = 0
 
-    def forward(self, x):
+    def forward(self, x, rng=None):
         b, h, w, c = x.shape
         return x.reshape(b, h * w, c)
 
@@ -114,7 +236,7 @@ class RnnToCnnPreProcessor(InputPreProcessor):
     width: int = 0
     channels: int = 0
 
-    def forward(self, x):
+    def forward(self, x, rng=None):
         return x.reshape(x.shape[0], self.height, self.width, self.channels)
 
     def output_type(self, input_type: InputType) -> InputType:

@@ -1,0 +1,93 @@
+"""Gradient normalization / clipping, applied between backprop and the
+updater.
+
+Reference: nn/conf/GradientNormalization.java (the 5-mode enum) applied in
+nn/updater/BaseMultiLayerUpdater.java preApply :310-352 — per layer, over
+that layer's full gradient view ("per layer") or over each named parameter
+array ("per param type"). Pretrain steps skip normalization (preApply
+:313).
+
+TPU design: one pure function over the gradient pytree, traced into the
+same jitted train step as backprop and the updater — the norms fuse into
+the update program instead of being a separate host-side pass.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+MODES = ("none", "renormalize_l2_per_layer", "renormalize_l2_per_param_type",
+         "clip_element_wise_absolute_value", "clip_l2_per_layer",
+         "clip_l2_per_param_type")
+
+# Guards division by an exactly-zero norm (all-zero gradients). The
+# reference divides unguarded and would produce inf; an eps floor keeps the
+# step finite without changing any non-degenerate result.
+_EPS = 1e-30
+
+
+def _global_l2(g: dict):
+    return jnp.sqrt(sum(jnp.sum(jnp.square(v)) for v in g.values()))
+
+
+def _apply_one(mode: str, threshold: float, g: dict) -> dict:
+    if mode == "renormalize_l2_per_layer":
+        l2 = jnp.maximum(_global_l2(g), _EPS)
+        return {k: v / l2 for k, v in g.items()}
+    if mode == "renormalize_l2_per_param_type":
+        return {k: v / jnp.maximum(jnp.linalg.norm(v.ravel()), _EPS)
+                for k, v in g.items()}
+    if mode == "clip_element_wise_absolute_value":
+        return {k: jnp.clip(v, -threshold, threshold) for k, v in g.items()}
+    if mode == "clip_l2_per_layer":
+        l2 = _global_l2(g)
+        scale = jnp.where(l2 > threshold, threshold / jnp.maximum(l2, _EPS),
+                          1.0)
+        return {k: v * scale.astype(v.dtype) for k, v in g.items()}
+    if mode == "clip_l2_per_param_type":
+        out = {}
+        for k, v in g.items():
+            l2 = jnp.linalg.norm(v.ravel())
+            scale = jnp.where(l2 > threshold,
+                              threshold / jnp.maximum(l2, _EPS), 1.0)
+            out[k] = v * scale.astype(v.dtype)
+        return out
+    raise ValueError(f"Unknown gradient_normalization '{mode}'; "
+                     f"choose one of {MODES}")
+
+
+def apply_gradient_normalization(layer_map: dict, grads: dict) -> dict:
+    """Apply each layer's configured mode to its gradient sub-tree.
+
+    ``layer_map``: {key: layer config} with keys matching the gradient
+    pytree's top level (layer index / vertex name). Layers with mode None
+    or "none" pass through untouched. Pure and jit-traceable.
+    """
+    out = dict(grads)
+    for key, layer in layer_map.items():
+        mode = getattr(layer, "gradient_normalization", None)
+        if mode is None or mode == "none" or key not in grads:
+            continue
+        if not grads[key]:
+            continue
+        threshold = getattr(layer, "gradient_normalization_threshold", None)
+        threshold = 1.0 if threshold is None else float(threshold)
+        out[key] = _apply_one(mode, threshold, grads[key])
+    return out
+
+
+def layer_map_for(net) -> dict:
+    """Gradient-pytree-keyed layer map for any net exposing either a
+    ``layers`` list (MultiLayerNetwork) or LayerVertex ``conf.vertices``
+    (ComputationGraph) — so trainers outside the net's own step (e.g.
+    ParallelWrapper) can apply the same normalization."""
+    layers = getattr(net, "layers", None)
+    if isinstance(layers, list):
+        return {str(i): l for i, l in enumerate(layers)}
+    vertices = getattr(getattr(net, "conf", None), "vertices", None)
+    if isinstance(vertices, dict):
+        from deeplearning4j_tpu.nn.conf.graph_conf import LayerVertex
+        return {name: v.layer for name, v in vertices.items()
+                if isinstance(v, LayerVertex)}
+    return {}

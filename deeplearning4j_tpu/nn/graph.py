@@ -25,6 +25,11 @@ from deeplearning4j_tpu.nn.conf.graph_conf import (
     LayerVertex,
 )
 from deeplearning4j_tpu.nn.conf.layers.misc import CenterLossOutputLayer
+from deeplearning4j_tpu.nn.conf.preprocessors import preprocessor_key
+from deeplearning4j_tpu.nn.gradient_normalization import (
+    apply_gradient_normalization,
+    layer_map_for,
+)
 from deeplearning4j_tpu.nn.multilayer import _split_state
 
 
@@ -99,7 +104,10 @@ class ComputationGraph:
                     and hasattr(v.layer, "compute_loss_per_example")):
                 x = v_in[0]
                 if v.preprocessor is not None:
-                    x = v.preprocessor.forward(x)
+                    # same derived key as LayerVertex.forward uses, so this
+                    # collected loss input is bit-identical to the vertex's
+                    # own activation even for stochastic preprocessors
+                    x = v.preprocessor.forward(x, rng=preprocessor_key(k))
                 loss_inputs[name] = x
             out, ns = v.forward(params.get(name, {}), vertex_state, v_in,
                                 masks=v_masks, ctx=ctx, train=train, rng=k)
@@ -226,6 +234,7 @@ class ComputationGraph:
 
             (loss, (new_states, new_carry, last_ins)), grads = \
                 jax.value_and_grad(loss_fn, has_aux=True)(params)
+            grads = apply_gradient_normalization(layer_map_for(self), grads)
             if lr_mults is not None:
                 steps, opt_state2 = updater.step(grads, opt_state, iteration,
                                                  lr_mults)
@@ -475,7 +484,7 @@ class ComputationGraph:
         # reference-sharing clone would be invalidated by further training
         net.params = jax.tree_util.tree_map(lambda a: a.copy(), self.params)
         net.state = jax.tree_util.tree_map(lambda a: a.copy(), self.state)
-        net.updater_state = jax.tree_util.tree_map(lambda a: a,
+        net.updater_state = jax.tree_util.tree_map(lambda a: a.copy(),
                                                    self.updater_state)
         net.iteration = self.iteration
         return net

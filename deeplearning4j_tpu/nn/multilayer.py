@@ -27,6 +27,11 @@ import numpy as np
 
 from deeplearning4j_tpu.nn.conf.builders import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.conf.layers.misc import CenterLossOutputLayer
+from deeplearning4j_tpu.nn.conf.preprocessors import preprocessor_key
+from deeplearning4j_tpu.nn.gradient_normalization import (
+    apply_gradient_normalization,
+    layer_map_for,
+)
 from deeplearning4j_tpu.utils.pytree import flatten_params, unflatten_params
 
 _RNN_KEYS = ("h", "c")
@@ -87,7 +92,10 @@ class MultiLayerNetwork:
         for i in range(n):
             layer = self.layers[i]
             if i in self.conf.preprocessors:
-                x = self.conf.preprocessors[i].forward(x)
+                # derived, never keys[i] itself: a stochastic preprocessor
+                # must not share its key with the layer behind it
+                pk = preprocessor_key(keys[i]) if rng is not None else None
+                x = self.conf.preprocessors[i].forward(x, rng=pk)
                 cur_mask = self.conf.preprocessors[i].feed_forward_mask(cur_mask)
             layer_state = dict(state.get(str(i), {}))
             if carry is not None and str(i) in carry:
@@ -139,7 +147,10 @@ class MultiLayerNetwork:
             last_in = last_in.astype(jnp.dtype(self.conf.dtype))
         out_layer = self.layers[out_idx]
         if out_idx in self.conf.preprocessors:
-            last_in = self.conf.preprocessors[out_idx].forward(last_in)
+            # rng was already split inside _forward; consume only a derived
+            # key here, never the parent itself
+            last_in = self.conf.preprocessors[out_idx].forward(
+                last_in, rng=preprocessor_key(rng))
         p_out = params[str(out_idx)]
         if isinstance(out_layer, CenterLossOutputLayer):
             per_ex = out_layer.compute_loss_per_example(
@@ -196,6 +207,7 @@ class MultiLayerNetwork:
 
             (loss, (new_states, new_carry, last_in)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
+            grads = apply_gradient_normalization(layer_map_for(self), grads)
             if lr_mults is not None:
                 steps, opt_state2 = updater.step(grads, opt_state, iteration,
                                                  lr_mults)
@@ -371,17 +383,24 @@ class MultiLayerNetwork:
 
         @jax.jit
         def pstep(p_layer, opt_state, all_params, rng, iteration, x):
+            # three independent keys: lower-stack forward (so stochastic
+            # preprocessors BELOW idx resample fresh every step instead of
+            # freezing on their rng=None fallback), this layer's input
+            # preprocessor, and the pretrain loss itself
+            k_fwd, k_prep, k_loss = jax.random.split(rng, 3)
             feats, _, _, _ = self._forward(all_params, self.state, x, None,
-                                           train=False, rng=None, upto=idx)
+                                           train=False, rng=k_fwd, upto=idx)
             if idx in self.conf.preprocessors:
-                feats = self.conf.preprocessors[idx].forward(feats)
+                feats = self.conf.preprocessors[idx].forward(feats,
+                                                             rng=k_prep)
 
             def loss_fn(pl):
                 if hasattr(layer, "pretrain_loss_per_example"):
-                    per = layer.pretrain_loss_per_example(pl[str(idx)], feats, rng)
+                    per = layer.pretrain_loss_per_example(pl[str(idx)], feats,
+                                                          k_loss)
                 else:
-                    per = layer.reconstruction_loss_per_example(pl[str(idx)], feats,
-                                                                rng)
+                    per = layer.reconstruction_loss_per_example(
+                        pl[str(idx)], feats, k_loss)
                 return jnp.mean(per)
 
             loss, grads = jax.value_and_grad(loss_fn)(p_layer)

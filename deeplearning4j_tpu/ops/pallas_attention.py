@@ -12,12 +12,13 @@ This kernel computes attention with the online-softmax (flash) recurrence:
 K/V stream through VMEM in blocks, scores never leave the chip, O(T) memory
 instead of O(T^2).
 
-Scope: forward pass, optionally causal, no key-padding mask (callers fall
-back to the stock path when a mask is present — see
-SelfAttentionLayer.forward's helper switch, the AlgoMode analog). Backward
-runs the stock XLA gradient via jax.custom_vjp with recompute, so training
-gets the memory/speed win on the forward leg and bit-identical gradients to
-the stock path.
+Scope: forward + backward, optionally causal, no key-padding mask (callers
+fall back to the stock path when a mask is present — see
+SelfAttentionLayer.forward's helper switch, the AlgoMode analog). The
+backward is the standard flash recompute-by-block scheme (dq kernel over
+q-blocks streaming K/V; dk/dv kernel over k-blocks streaming Q/dO), so
+long-T *training* keeps O(T) memory — scores are rebuilt from the saved
+row-logsumexp L and never materialise in HBM.
 
 Parity contract (the cuDNN-test pattern): tests/test_pallas_attention.py
 compares kernel output and gradients against ``scaled_dot_attention`` in
@@ -36,11 +37,20 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
+def _causal_mask(s, iq, ik, block_q, block_k):
+    rows = (jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            + iq * block_q)
+    cols = (jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            + ik * block_k)
+    return jnp.where(rows >= cols, s, NEG_INF)
+
+
+def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float,
                      causal: bool, block_q: int, block_k: int, seq_len: int):
     """One (batch*head, q-block) program: stream K/V blocks with the online
     softmax recurrence. q_ref: [block_q, d]; k_ref/v_ref: [T, d] (VMEM);
-    o_ref: [block_q, d]."""
+    o_ref: [block_q, d]; lse_ref: [block_q, 1] row logsumexp (saved for the
+    backward recompute)."""
     iq = pl.program_id(1)
     q = q_ref[:].astype(jnp.float32) * sm_scale
     d = q.shape[-1]
@@ -60,13 +70,7 @@ def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            rows = (jax.lax.broadcasted_iota(jnp.int32,
-                                             (block_q, block_k), 0)
-                    + iq * block_q)
-            cols = (jax.lax.broadcasted_iota(jnp.int32,
-                                             (block_q, block_k), 1)
-                    + i * block_k)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            s = _causal_mask(s, iq, i, block_q, block_k)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new[:, None])
@@ -81,6 +85,7 @@ def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
     l0 = jnp.zeros((block_q,), jnp.float32)
     acc, m, l = jax.lax.fori_loop(0, nk_eff, body, (acc, m0, l0))
     o_ref[:] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    lse_ref[:] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, None]
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
@@ -95,7 +100,7 @@ def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int,
     kernel = functools.partial(
         _attn_fwd_kernel, sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_k=block_k, seq_len=T)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, T // block_q),
         in_specs=[
@@ -106,24 +111,191 @@ def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((None, T, d), lambda b, i: (b, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((B * H, T, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, d), q.dtype),
+            jax.ShapeDtypeStruct((B * H, T, 1), jnp.float32),
+        ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(B, H, T, d)
+    return out.reshape(B, H, T, d), lse
+
+
+def _attn_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                    *, sm_scale: float, causal: bool, block_q: int,
+                    block_k: int, seq_len: int):
+    """dQ for one (batch*head, q-block): stream K/V, recompute P from the
+    saved logsumexp, accumulate dS K. All VMEM-resident, f32 accumulation."""
+    iq = pl.program_id(1)
+    q = q_ref[:].astype(jnp.float32) * sm_scale
+    do = do_ref[:].astype(jnp.float32)
+    lse = lse_ref[:].astype(jnp.float32)          # [block_q, 1]
+    delta = delta_ref[:].astype(jnp.float32)      # [block_q, 1]
+    d = q.shape[-1]
+    nk = seq_len // block_k
+    if causal:
+        nk_eff = jnp.minimum(jnp.int32(nk),
+                             ((iq + 1) * block_q - 1) // block_k + 1)
+    else:
+        nk_eff = nk
+
+    def body(i, dq):
+        k_blk = k_ref[pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, iq, i, block_q, block_k)
+        p = jnp.exp(s - lse)                      # normalized probabilities
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(ds, k_blk, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, nk_eff, body, jnp.zeros((block_q, d),
+                                                      jnp.float32))
+    dq_ref[:] = (dq * sm_scale).astype(dq_ref.dtype)
+
+
+def _attn_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dk_ref, dv_ref, *, sm_scale: float, causal: bool,
+                     block_q: int, block_k: int, seq_len: int):
+    """dK/dV for one (batch*head, k-block): stream Q/dO blocks, recompute
+    P^T, accumulate dV = P^T dO and dK = dS^T Q * scale."""
+    ik = pl.program_id(1)
+    k_blk = k_ref[:].astype(jnp.float32)          # [block_k, d]
+    v_blk = v_ref[:].astype(jnp.float32)
+    d = k_blk.shape[-1]
+    nq = seq_len // block_q
+    if causal:
+        # q-blocks strictly above (before) this k-block's diagonal see none
+        # of its columns: start at the block containing row ik*block_k
+        iq0 = (ik * block_k) // block_q
+    else:
+        iq0 = 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32) \
+            * sm_scale
+        do = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        delta = delta_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, i, ik, block_q, block_k)
+        p = jnp.exp(s - lse)                      # [block_q, block_k]
+        dv_new = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_new = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    zeros = jnp.zeros((k_blk.shape[0], d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(iq0, nq, body, (zeros, zeros))
+    # dk = dS^T (q * sm_scale): q was loaded pre-scaled, no extra factor
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                             "interpret"))
+def _flash_backward(q, k, v, o, lse, do, *, causal: bool, block_q: int,
+                    block_k: int, interpret: bool):
+    B, H, T, d = q.shape
+    sm_scale = 1.0 / (d ** 0.5)
+    flat = lambda a: a.reshape(B * H, T, d)
+    qf, kf, vf, dof = flat(q), flat(k), flat(v), flat(do)
+    # D_i = dO_i . O_i — one fused elementwise-reduce in XLA, O(T d) reads
+    delta = jnp.sum(dof.astype(jnp.float32)
+                    * flat(o).astype(jnp.float32), axis=-1, keepdims=True)
+
+    blk_q = pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM)
+    blk_q1 = pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0),
+                          memory_space=pltpu.VMEM)
+    blk_k = pl.BlockSpec((None, block_k, d), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM)
+    full = pl.BlockSpec((None, T, d), lambda b, i: (b, 0, 0),
+                        memory_space=pltpu.VMEM)
+    full1 = pl.BlockSpec((None, T, 1), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(_attn_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_len=T),
+        grid=(B * H, T // block_q),
+        in_specs=[blk_q, full, full, blk_q, blk_q1, blk_q1],
+        out_specs=blk_q,
+        out_shape=jax.ShapeDtypeStruct((B * H, T, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_attn_dkv_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          seq_len=T),
+        grid=(B * H, T // block_k),
+        in_specs=[full, blk_k, blk_k, full, full1, full1],
+        out_specs=[blk_k, blk_k],
+        out_shape=[jax.ShapeDtypeStruct((B * H, T, d), k.dtype),
+                   jax.ShapeDtypeStruct((B * H, T, d), v.dtype)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    unflat = lambda a: a.reshape(B, H, T, d)
+    return unflat(dq), unflat(dk), unflat(dv)
 
 
 DEFAULT_BLOCK = 512  # tuned on v5e: T=2048 1.5x, T=4096 2.9x over stock
 
+# Each program holds full K and V [T, d] blocks in VMEM as f32 (~2*T*d*4
+# bytes) plus the q/o blocks and accumulators; cap K+V at ~8 MiB of the
+# ~16 MiB VMEM so long sequences fall back to stock instead of crashing.
+VMEM_SEQ_ELEMS_LIMIT = 1 << 20  # T * d ceiling (8192 * 128)
 
-def supports(q_shape, *, mask, block_q: int = DEFAULT_BLOCK,
-             block_k: int = DEFAULT_BLOCK) -> bool:
-    """Whether the kernel handles this case (callers fall back otherwise).
-    Blocks are clamped to T, so the only requirement is divisibility."""
+
+def supports(q_shape, *, mask, dtype=jnp.float32,
+             block_q: int = DEFAULT_BLOCK,
+             block_k: int = DEFAULT_BLOCK, backend: str | None = None) -> bool:
+    """Whether the ``auto`` helper should route here (callers fall back to
+    the stock XLA path otherwise). Declines when:
+
+    - a key mask is present (kernel has no mask support);
+    - dtype is wider than float32 — the kernel casts to and accumulates in
+      f32, so a float64 network would silently lose precision (breaks
+      gradchecks); bf16/f16 inputs are fine (they gain precision);
+    - the backend is not TPU — off-TPU the kernel runs in interpret mode,
+      orders of magnitude slower than stock (``helper='pallas'`` still
+      forces it, which is what the parity tests use);
+    - T*d exceeds the VMEM ceiling (full K/V live in VMEM per program);
+    - T is not divisible by the (T-clamped) block sizes.
+    """
     if mask is not None or len(q_shape) != 4:
         return False
-    T = q_shape[2]
+    if jnp.dtype(dtype) not in (jnp.dtype(jnp.float32),
+                                jnp.dtype(jnp.bfloat16),
+                                jnp.dtype(jnp.float16)):
+        return False
+    if backend is None:
+        backend = jax.default_backend()
+    if backend != "tpu":
+        return False
+    T, d = q_shape[2], q_shape[3]
+    if T * d > VMEM_SEQ_ELEMS_LIMIT:
+        return False
     return T % min(block_q, T) == 0 and T % min(block_k, T) == 0
 
 
@@ -134,8 +306,10 @@ def flash_attention(q, k, v, *, causal: bool = False,
 
     q/k/v: [B, H, T, d], T divisible by the (T-clamped) block sizes.
     ``interpret=None`` auto-selects interpreter mode off-TPU (so the same
-    call works in the CPU test mesh). Gradients: stock XLA attention vjp on
-    recomputed forward (jax.custom_vjp)."""
+    call works in the CPU test mesh). Gradients: Pallas recompute-by-block
+    backward (dq / dk+dv kernels) from the saved row-logsumexp — O(T)
+    memory for training too, unlike a stock-XLA vjp which would
+    re-materialise the [B,H,T,T] score matrix in HBM."""
     T = q.shape[2]
     block_q = min(block_q, T)
     block_k = min(block_k, T)
@@ -143,25 +317,20 @@ def flash_attention(q, k, v, *, causal: bool = False,
         interpret = jax.default_backend() != "tpu"
     fwd = functools.partial(_flash_forward, causal=causal, block_q=block_q,
                             block_k=block_k, interpret=interpret)
+    bwd = functools.partial(_flash_backward, causal=causal, block_q=block_q,
+                            block_k=block_k, interpret=interpret)
 
     @jax.custom_vjp
     def attn(q, k, v):
-        return fwd(q, k, v)
+        return fwd(q, k, v)[0]
 
     def attn_fwd(q, k, v):
-        return fwd(q, k, v), (q, k, v)
+        o, lse = fwd(q, k, v)
+        return o, (q, k, v, o, lse)
 
     def attn_bwd(res, g):
-        from deeplearning4j_tpu.nn.conf.layers.attention import (
-            scaled_dot_attention,
-        )
-
-        q, k, v = res
-        _, vjp = jax.vjp(
-            lambda q_, k_, v_: scaled_dot_attention(q_, k_, v_,
-                                                    causal=causal),
-            q, k, v)
-        return vjp(g)
+        q, k, v, o, lse = res
+        return bwd(q, k, v, o, lse, g)
 
     attn.defvjp(attn_fwd, attn_bwd)
     return attn(q, k, v)

@@ -162,8 +162,7 @@ class ShardedTrainer:
                 f"batch size {feats.shape[0]} not divisible by data-axis "
                 f"size {d}")
         out = []
-        for a in (feats, np.asarray(ds.labels),
-                  ds.features_mask, ds.labels_mask):
+        for a in (feats, ds.labels, ds.features_mask, ds.labels_mask):
             if a is None:
                 out.append(None)
                 continue

@@ -24,6 +24,7 @@ equals single-device training on the concatenated N*B batch, to float tolerance.
 
 from __future__ import annotations
 
+import warnings
 from typing import Optional
 
 import jax
@@ -32,6 +33,10 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from deeplearning4j_tpu.nn.gradient_normalization import (
+    apply_gradient_normalization,
+    layer_map_for,
+)
 from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, data_mesh
 
 AVERAGING = "averaging"
@@ -60,6 +65,9 @@ class ParallelWrapper:
         self.mode = mode
         self.average_updaters = average_updaters
         self.report_score = report_score
+        # mid-stream batches whose size didn't match the stream's (dropped
+        # with a warning — see fit); genuine trailing partials not counted
+        self.dropped_batches = 0
         self._round_cache: dict = {}
 
     # ------------------------------------------------------------------ build
@@ -67,6 +75,7 @@ class ParallelWrapper:
         net = self.net
         updater = net.conf.updater
         lr_mults = net._lr_mult_tree() if hasattr(net, "_lr_mult_tree") else None
+        layer_map = layer_map_for(net)
         pmean_grads = self.mode == SHARED_GRADIENTS
         avg_params = self.mode == AVERAGING
         average_updaters = self.average_updaters
@@ -104,6 +113,11 @@ class ParallelWrapper:
                     loss_fn, has_aux=True)(params)
                 if pmean_grads:
                     grads = lax.pmean(grads, DATA_AXIS)
+                # after the pmean: SHARED_GRADIENTS normalizes the global
+                # gradient exactly as a single device would on the
+                # concatenated batch (the module's parity contract);
+                # AVERAGING normalizes each worker's local step
+                grads = apply_gradient_normalization(layer_map, grads)
                 if lr_mults is not None:
                     steps, opt = updater.step(grads, opt, it, lr_mults)
                 else:
@@ -157,18 +171,34 @@ class ParallelWrapper:
             if hasattr(iterator, "reset"):
                 iterator.reset()
             buf = []
-            for ds in iterator:
+            stream = iter(iterator)
+            ds = next(stream, None)
+            while ds is not None:
+                nxt = next(stream, None)
                 b = np.asarray(ds.features).shape[0]
                 if expected_batch is None:
                     expected_batch = b
                 if b != expected_batch:
-                    # undersized trailing minibatch: dropped, like trailing
-                    # partial worker groups (static shapes keep one XLA program)
+                    # a genuinely-final undersized minibatch is a trailing
+                    # partial: skipped silently like trailing partial worker
+                    # groups (static shapes keep one XLA program). Any OTHER
+                    # mismatch is data the caller expects to train on —
+                    # count it and warn instead of silently losing it.
+                    if not (nxt is None and b < expected_batch):
+                        self.dropped_batches += 1
+                        warnings.warn(
+                            f"ParallelWrapper dropped a mid-stream minibatch "
+                            f"of size {b} (expected {expected_batch}): all "
+                            f"non-trailing minibatches must share one batch "
+                            f"size ({self.dropped_batches} dropped so far)",
+                            stacklevel=2)
+                    ds = nxt
                     continue
                 buf.append(ds)
                 if len(buf) == need:
                     self._fit_round(buf)
                     buf = []
+                ds = nxt
             # trailing partial group: dropped (reference parity)
             for listener in getattr(net, "listeners", []):
                 listener.on_epoch_end(net)

@@ -1,8 +1,12 @@
 """Dimensionality-reduction plotting tools (reference: deeplearning4j-core
-plot/ — BarnesHutTsne.java:65)."""
+plot/ — BarnesHutTsne.java:65).
 
+``Tsne`` is the exact O(N^2) device implementation (the MXU eats it for
+N <= ~5k); ``BarnesHutTsne`` is the grid-ladder Barnes-Hut implementation
+(sparse kNN attraction + FMM-style far-field, O(N log N)-class) for
+reference-scale N."""
+
+from deeplearning4j_tpu.plot.barnes_hut import BarnesHutTsne
 from deeplearning4j_tpu.plot.tsne import Tsne
-
-BarnesHutTsne = Tsne  # reference-name alias
 
 __all__ = ["Tsne", "BarnesHutTsne"]

@@ -266,3 +266,22 @@ class TestGraphEmbeddings:
         for walk in RandomWalkIterator(g, walk_length=10, seed=0):
             for a, b in zip(walk, walk[1:]):
                 assert b in g.neighbors(a) or a == b
+
+
+def test_dataset_without_labels_supports_all_helpers():
+    """labels=None (pretraining datasets) must survive shuffle, batching,
+    splitting and merge instead of dying in numpy."""
+    import numpy as np
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    x = np.arange(20, dtype=np.float32).reshape(10, 2)
+    ds = DataSet(x, None)
+    ds.shuffle(seed=0)
+    assert ds.labels is None
+    tr, te = ds.split_test_and_train(6)
+    assert tr.labels is None and te.num_examples() == 4
+    batches = list(ds.batch_by(4))
+    assert [b.num_examples() for b in batches] == [4, 4, 2]
+    assert all(b.labels is None for b in batches)
+    merged = DataSet.merge(batches)
+    assert merged.labels is None and merged.num_examples() == 10

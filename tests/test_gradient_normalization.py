@@ -1,0 +1,204 @@
+"""GradientNormalization: the 5 modes vs hand-computed values, plus
+end-to-end application inside the jitted train step.
+
+Reference: nn/conf/GradientNormalization.java, applied in
+nn/updater/BaseMultiLayerUpdater.java preApply :310-352; reference tests:
+gradientcheck + updater tests (TestGradientNormalization.java).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.gradient_normalization import (
+    apply_gradient_normalization,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Sgd
+
+
+def _grads():
+    rs = np.random.RandomState(0)
+    return {"W": jnp.asarray(rs.randn(4, 3) * 2, jnp.float64),
+            "b": jnp.asarray(rs.randn(3) * 5, jnp.float64)}
+
+
+def _layer(mode, threshold=1.0):
+    lyr = DenseLayer(n_out=3, gradient_normalization=mode,
+                     gradient_normalization_threshold=threshold)
+    return {"0": lyr}
+
+
+class TestModes:
+    def test_renormalize_l2_per_layer(self):
+        g = _grads()
+        out = apply_gradient_normalization(
+            _layer("renormalize_l2_per_layer"), {"0": g})["0"]
+        l2 = np.sqrt(sum(np.sum(np.asarray(v) ** 2) for v in g.values()))
+        for k in g:
+            np.testing.assert_allclose(np.asarray(out[k]),
+                                       np.asarray(g[k]) / l2, rtol=1e-12)
+        # whole-layer norm is 1 afterwards
+        total = np.sqrt(sum(np.sum(np.asarray(v) ** 2)
+                            for v in out.values()))
+        np.testing.assert_allclose(total, 1.0, rtol=1e-12)
+
+    def test_renormalize_l2_per_param_type(self):
+        g = _grads()
+        out = apply_gradient_normalization(
+            _layer("renormalize_l2_per_param_type"), {"0": g})["0"]
+        for k in g:
+            l2 = np.linalg.norm(np.asarray(g[k]).ravel())
+            np.testing.assert_allclose(np.asarray(out[k]),
+                                       np.asarray(g[k]) / l2, rtol=1e-12)
+            np.testing.assert_allclose(
+                np.linalg.norm(np.asarray(out[k]).ravel()), 1.0, rtol=1e-12)
+
+    def test_clip_element_wise_absolute_value(self):
+        g = _grads()
+        out = apply_gradient_normalization(
+            _layer("clip_element_wise_absolute_value", 0.5), {"0": g})["0"]
+        for k in g:
+            np.testing.assert_allclose(
+                np.asarray(out[k]), np.clip(np.asarray(g[k]), -0.5, 0.5),
+                rtol=1e-12)
+
+    def test_clip_l2_per_layer_scales_only_above_threshold(self):
+        g = _grads()
+        l2 = np.sqrt(sum(np.sum(np.asarray(v) ** 2) for v in g.values()))
+        # above threshold: scaled back to exactly threshold
+        out = apply_gradient_normalization(
+            _layer("clip_l2_per_layer", l2 / 2), {"0": g})["0"]
+        for k in g:
+            np.testing.assert_allclose(
+                np.asarray(out[k]), np.asarray(g[k]) * 0.5, rtol=1e-12)
+        # below threshold: untouched
+        out2 = apply_gradient_normalization(
+            _layer("clip_l2_per_layer", l2 * 2), {"0": g})["0"]
+        for k in g:
+            np.testing.assert_allclose(np.asarray(out2[k]),
+                                       np.asarray(g[k]), rtol=1e-12)
+
+    def test_clip_l2_per_param_type(self):
+        g = _grads()
+        t = float(np.linalg.norm(np.asarray(g["b"]))) / 2
+        out = apply_gradient_normalization(
+            _layer("clip_l2_per_param_type", t), {"0": g})["0"]
+        for k in g:
+            l2 = np.linalg.norm(np.asarray(g[k]).ravel())
+            expect = (np.asarray(g[k]) * (t / l2) if l2 > t
+                      else np.asarray(g[k]))
+            np.testing.assert_allclose(np.asarray(out[k]), expect,
+                                       rtol=1e-12)
+
+    def test_none_and_missing_pass_through(self):
+        g = _grads()
+        out = apply_gradient_normalization(_layer("none"), {"0": g})
+        assert out["0"] is g
+        out = apply_gradient_normalization(_layer(None), {"0": g})
+        assert out["0"] is g
+
+    def test_unknown_mode_raises(self):
+        with pytest.raises(ValueError, match="Unknown gradient_norm"):
+            apply_gradient_normalization(_layer("bogus"), {"0": _grads()})
+
+    def test_zero_gradient_stays_finite(self):
+        z = {"W": jnp.zeros((2, 2), jnp.float64)}
+        out = apply_gradient_normalization(
+            _layer("renormalize_l2_per_layer"), {"0": z})["0"]
+        assert np.isfinite(np.asarray(out["W"])).all()
+
+
+class TestInTrainStep:
+    def _net(self, **norm):
+        conf = (NeuralNetConfiguration.builder().seed(5)
+                .updater(Sgd(learning_rate=1.0))
+                .dtype("float64")
+                .list(DenseLayer(n_out=8, activation="tanh", **norm),
+                      OutputLayer(n_out=3, activation="softmax",
+                                  loss="mcxent", **norm))
+                .set_input_type(InputType.feed_forward(6)).build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_clip_bounds_the_sgd_step(self):
+        """With Sgd(lr) and element-wise clipping at t, every parameter
+        moves by at most lr*t — hand-computable from the update rule."""
+        t = 1e-3
+        net = self._net(gradient_normalization=(
+            "clip_element_wise_absolute_value"),
+            gradient_normalization_threshold=t)
+        rs = np.random.RandomState(2)
+        x = rs.randn(16, 6) * 10  # large inputs -> large raw gradients
+        y = np.eye(3)[rs.randint(0, 3, 16)]
+        before = np.asarray(net.params_flat())
+        net.do_step(x, y)
+        after = np.asarray(net.params_flat())
+        assert np.max(np.abs(after - before)) <= t * 1.0 + 1e-12
+
+    def test_global_conf_inherited_by_layers(self):
+        conf = (NeuralNetConfiguration.builder().seed(5)
+                .updater(Sgd(learning_rate=0.1))
+                .gradient_normalization("clip_l2_per_layer")
+                .gradient_normalization_threshold(2.5)
+                .list(DenseLayer(n_out=4, activation="tanh"),
+                      OutputLayer(n_out=2, activation="softmax",
+                                  loss="mcxent"))
+                .set_input_type(InputType.feed_forward(3)).build())
+        net = MultiLayerNetwork(conf).init()
+        for lyr in net.layers:
+            assert lyr.gradient_normalization == "clip_l2_per_layer"
+            assert lyr.gradient_normalization_threshold == 2.5
+
+    def test_renormalize_trains(self):
+        """RenormalizeL2PerLayer still converges on a toy problem."""
+        net = self._net(gradient_normalization="renormalize_l2_per_layer")
+        rs = np.random.RandomState(3)
+        x = rs.randn(32, 6)
+        y = np.eye(3)[(x[:, 0] > 0).astype(int) + (x[:, 1] > 0).astype(int)]
+        losses = [float(net.do_step(x, y)[0]) for _ in range(30)]
+        assert losses[-1] < losses[0]
+
+
+def test_threshold_zero_is_respected():
+    """threshold=0.0 must clip everything to zero, not fall back to 1.0."""
+    g = {"0": _grads()}
+    out = apply_gradient_normalization(
+        _layer("clip_element_wise_absolute_value", 0.0), g)["0"]
+    for k in out:
+        np.testing.assert_array_equal(np.asarray(out[k]), 0.0)
+
+
+def test_parallel_wrapper_applies_normalization():
+    """ParallelWrapper SHARED_GRADIENTS with clipping == single device with
+    clipping on the concatenated batch (the module's parity contract)."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.parallel import ParallelWrapper
+
+    def build():
+        conf = (NeuralNetConfiguration.builder().seed(11)
+                .updater(Sgd(learning_rate=0.5))
+                .gradient_normalization("clip_element_wise_absolute_value")
+                .gradient_normalization_threshold(1e-3)
+                .list(DenseLayer(n_out=8, activation="tanh"),
+                      OutputLayer(n_out=3, activation="softmax",
+                                  loss="mcxent"))
+                .set_input_type(InputType.feed_forward(6)).build())
+        return MultiLayerNetwork(conf).init()
+
+    rs = np.random.RandomState(7)
+    x = (rs.randn(32, 6) * 10).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 32)]
+
+    single = build()
+    single.do_step(x, y)
+
+    dist = build()
+    pw = ParallelWrapper(dist, workers=8, averaging_frequency=1,
+                         mode="shared_gradients")
+    pw.fit([DataSet(x[i * 4:(i + 1) * 4], y[i * 4:(i + 1) * 4])
+            for i in range(8)], epochs=1)
+    np.testing.assert_allclose(np.asarray(dist.params_flat()),
+                               np.asarray(single.params_flat()), atol=1e-6)

@@ -69,11 +69,22 @@ class TestFlashAttentionKernel:
                                    atol=1e-5, rtol=1e-5)
 
     def test_supports_gating(self):
-        assert supports((2, 3, 256, 64), mask=None)
-        assert supports((2, 3, 250, 64), mask=None)  # clamps to one block
+        ok = dict(mask=None, backend="tpu")
+        assert supports((2, 3, 256, 64), **ok)
+        assert supports((2, 3, 250, 64), **ok)  # clamps to one block
         # larger than a block but not divisible -> stock fallback
-        assert not supports((2, 3, 600, 64), mask=None)
-        assert not supports((2, 3, 256, 64), mask=np.ones((2, 256)))
+        assert not supports((2, 3, 600, 64), **ok)
+        assert not supports((2, 3, 256, 64), mask=np.ones((2, 256)),
+                            backend="tpu")
+        # f32-accumulating kernel must decline float64 networks, but
+        # narrower dtypes only gain precision through it
+        assert not supports((2, 3, 256, 64), dtype=jnp.float64, **ok)
+        assert supports((2, 3, 256, 64), dtype=jnp.bfloat16, **ok)
+        # off-TPU the kernel would run in interpret mode: decline
+        assert not supports((2, 3, 256, 64), mask=None, backend="cpu")
+        # full K/V live in VMEM per program: decline past the ceiling
+        assert supports((2, 3, 8192, 128), **ok)
+        assert not supports((2, 3, 16384, 128), **ok)
 
 
 class TestSelfAttentionHelperSwitch:

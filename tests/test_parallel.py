@@ -167,3 +167,35 @@ def test_distributed_evaluation_merge():
     ev_par = evaluate_on_mesh(net, ListDataSetIterator(batches, batch_size=16))
     assert ev_par.accuracy() == pytest.approx(ev_seq.accuracy())
     assert ev_par.f1() == pytest.approx(ev_seq.f1())
+
+
+def test_mid_stream_batch_mismatch_warns_and_counts():
+    """A mid-stream minibatch of odd size is dropped WITH a warning and
+    counted; a genuine trailing partial is skipped silently (reference
+    semantics: ParallelWrapper.java:409-487 drops only trailing partial
+    worker groups)."""
+    import warnings as _w
+
+    x, y = _make_data(8 * 4)
+    batches = [DataSet(x[i * 4:(i + 1) * 4], y[i * 4:(i + 1) * 4])
+               for i in range(8)]
+    odd = DataSet(x[:2], y[:2])
+
+    # mid-stream odd batch -> warning + counter
+    net = MultiLayerNetwork(_mlp_conf(Sgd(learning_rate=0.1))).init()
+    pw = ParallelWrapper(net, workers=8, averaging_frequency=1)
+    stream = batches[:4] + [odd] + batches[4:]
+    with _w.catch_warnings(record=True) as caught:
+        _w.simplefilter("always")
+        pw.fit(stream, epochs=1)
+    assert pw.dropped_batches == 1
+    assert any("mid-stream" in str(w.message) for w in caught)
+
+    # trailing partial -> silent, not counted
+    net2 = MultiLayerNetwork(_mlp_conf(Sgd(learning_rate=0.1))).init()
+    pw2 = ParallelWrapper(net2, workers=8, averaging_frequency=1)
+    with _w.catch_warnings(record=True) as caught2:
+        _w.simplefilter("always")
+        pw2.fit(batches + [odd], epochs=1)
+    assert pw2.dropped_batches == 0
+    assert not any("mid-stream" in str(w.message) for w in caught2)

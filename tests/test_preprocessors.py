@@ -1,0 +1,148 @@
+"""InputPreProcessors: all 12 of the reference's nn/conf/preprocessor/ set —
+shape round-trips for the adapters, value checks for the normalizers,
+straight-through sampling, and composition.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.preprocessors import (
+    BinomialSamplingPreProcessor,
+    CnnToFeedForwardPreProcessor,
+    CnnToRnnPreProcessor,
+    ComposableInputPreProcessor,
+    FeedForwardToCnnPreProcessor,
+    FeedForwardToRnnPreProcessor,
+    RnnToCnnPreProcessor,
+    RnnToFeedForwardPreProcessor,
+    UnitVarianceProcessor,
+    ZeroMeanAndUnitVariancePreProcessor,
+    ZeroMeanPrePreProcessor,
+)
+from deeplearning4j_tpu.utils.serde import from_json, to_json
+
+
+def _x(*shape, seed=0):
+    return jnp.asarray(np.random.RandomState(seed).randn(*shape),
+                       jnp.float64)
+
+
+class TestShapeAdapters:
+    def test_cnn_ff_round_trip(self):
+        x = _x(2, 4, 5, 3)
+        flat = CnnToFeedForwardPreProcessor(4, 5, 3).forward(x)
+        assert flat.shape == (2, 60)
+        back = FeedForwardToCnnPreProcessor(4, 5, 3).forward(flat)
+        np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+
+    def test_rnn_cnn_round_trip(self):
+        x = _x(2, 20, 3)  # T = H*W = 20
+        img = RnnToCnnPreProcessor(4, 5, 3).forward(x)
+        assert img.shape == (2, 4, 5, 3)
+        back = CnnToRnnPreProcessor(4, 5, 3).forward(img)
+        np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+
+    def test_rnn_ff_shapes(self):
+        x = _x(2, 7, 5)
+        assert RnnToFeedForwardPreProcessor().forward(x).shape == (14, 5)
+        y = _x(3, 6)
+        assert FeedForwardToRnnPreProcessor().forward(y).shape == (3, 1, 6)
+
+    def test_output_types(self):
+        t = CnnToFeedForwardPreProcessor(4, 5, 3).output_type(
+            InputType.convolutional(4, 5, 3))
+        assert t.kind == "feed_forward" and t.flat_size() == 60
+        t = RnnToCnnPreProcessor(4, 5, 3).output_type(
+            InputType.recurrent(3, 20))
+        assert t.kind == "convolutional"
+
+
+class TestNormalizers:
+    def test_zero_mean(self):
+        x = _x(8, 5)
+        out = ZeroMeanPrePreProcessor().forward(x)
+        np.testing.assert_allclose(np.asarray(jnp.mean(out, axis=0)), 0,
+                                   atol=1e-12)
+
+    def test_unit_variance(self):
+        x = _x(8, 5, seed=1) * 7
+        out = UnitVarianceProcessor().forward(x)
+        np.testing.assert_allclose(np.asarray(jnp.std(out, axis=0, ddof=1)),
+                                   1.0, atol=1e-3)
+
+    def test_zero_mean_unit_variance(self):
+        x = _x(16, 4, seed=2) * 3 + 10
+        out = ZeroMeanAndUnitVariancePreProcessor().forward(x)
+        np.testing.assert_allclose(np.asarray(jnp.mean(out, axis=0)), 0,
+                                   atol=1e-10)
+        np.testing.assert_allclose(np.asarray(jnp.std(out, axis=0, ddof=1)),
+                                   1.0, atol=1e-3)
+
+    def test_backprop_is_pass_through(self):
+        """Reference backprop returns epsilon unchanged: the batch
+        statistics must be gradient-constants."""
+        x = _x(6, 3, seed=3)
+        for proc in (ZeroMeanPrePreProcessor(), UnitVarianceProcessor(),
+                     ZeroMeanAndUnitVariancePreProcessor()):
+            g = jax.grad(lambda v: jnp.sum(proc.forward(v) * 2.0))(x)
+            if isinstance(proc, ZeroMeanPrePreProcessor):
+                expect = np.full_like(np.asarray(x), 2.0)
+            else:
+                std = np.std(np.asarray(x), axis=0, ddof=1) + 1e-5
+                expect = 2.0 / std * np.ones_like(np.asarray(x))
+            np.testing.assert_allclose(np.asarray(g), expect, rtol=1e-10)
+
+
+class TestBinomialSampling:
+    def test_samples_are_binary_and_straight_through(self):
+        p = jnp.asarray(np.random.RandomState(4).rand(32, 8), jnp.float64)
+        proc = BinomialSamplingPreProcessor(seed=9)
+        out = proc.forward(p)
+        vals = np.unique(np.asarray(out))
+        assert set(vals).issubset({0.0, 1.0})
+        # straight-through: gradient flows as if identity
+        g = jax.grad(lambda v: jnp.sum(proc.forward(v) * 3.0))(p)
+        np.testing.assert_allclose(np.asarray(g), 3.0)
+
+    def test_sampling_tracks_probabilities(self):
+        p = jnp.full((2000,), 0.75, jnp.float64)
+        out = BinomialSamplingPreProcessor(seed=1).forward(p)
+        assert abs(float(jnp.mean(out)) - 0.75) < 0.05
+
+
+class TestComposable:
+    def test_chain_applies_in_order(self):
+        x = _x(4, 4, 5, 3, seed=5)
+        comp = ComposableInputPreProcessor(processors=[
+            CnnToFeedForwardPreProcessor(4, 5, 3),
+            ZeroMeanPrePreProcessor(),
+        ])
+        out = comp.forward(x)
+        assert out.shape == (4, 60)
+        np.testing.assert_allclose(np.asarray(jnp.mean(out, axis=0)), 0,
+                                   atol=1e-12)
+        t = comp.output_type(InputType.convolutional(4, 5, 3))
+        assert t.kind == "feed_forward" and t.flat_size() == 60
+
+    def test_serde_round_trip(self):
+        comp = ComposableInputPreProcessor(processors=[
+            CnnToFeedForwardPreProcessor(4, 5, 3),
+            BinomialSamplingPreProcessor(seed=3),
+        ])
+        back = from_json(to_json(comp))
+        assert back == comp
+
+    def test_fresh_rng_gives_fresh_samples(self):
+        """Training threads the per-step rng: different keys must give
+        different samples (the reference redraws each call), while the
+        straight-through gradient stays identity."""
+        p = jnp.asarray(np.random.RandomState(6).rand(16, 8), jnp.float64)
+        proc = BinomialSamplingPreProcessor(seed=0)
+        a = proc.forward(p, rng=jax.random.PRNGKey(1))
+        b = proc.forward(p, rng=jax.random.PRNGKey(2))
+        assert not np.array_equal(np.asarray(a), np.asarray(b))
+        g = jax.grad(lambda v: jnp.sum(proc.forward(
+            v, rng=jax.random.PRNGKey(1)) * 2.0))(p)
+        np.testing.assert_allclose(np.asarray(g), 2.0)

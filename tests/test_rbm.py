@@ -1,0 +1,179 @@
+"""RBM layer: CD-k statistics, pretraining, supervised use, serde.
+
+Parity targets: nn/conf/layers/RBM.java (config surface) and
+nn/layers/feedforward/rbm/RBM.java (propUp :324, propDown :390, CD gradient
+statistics :160-190). The reference validates RBMs through RBMTests
+(pretraining drives reconstruction error down) and through gradient checks
+of networks containing pretrain layers; both patterns appear here. The CD-k
+gradient itself is checked against the hand-computed Hinton statistics —
+the strongest possible test, since CD is not the gradient of any scalar
+loss a finite-difference check could probe through the sampling chain.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.gradientcheck import check_gradients
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import RBM, DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Sgd
+from deeplearning4j_tpu.datasets import DataSet
+from deeplearning4j_tpu.utils.serde import from_json, to_json
+
+
+def _rbm_params(n_in=6, n_out=4, seed=0, dtype=jnp.float64):
+    lyr = RBM(n_in=n_in, n_out=n_out, bias_init=0.0)
+    params = lyr.init_params(jax.random.PRNGKey(seed), dtype=dtype)
+    return lyr, params
+
+
+class TestCdStatistics:
+    def test_cd1_gradient_matches_hinton_statistics(self):
+        """jax.grad of the surrogate == -(pos - neg) computed by hand."""
+        lyr, params = _rbm_params()
+        rs = np.random.RandomState(3)
+        x = jnp.asarray(rs.rand(8, 6) > 0.5, jnp.float64)
+        rng = jax.random.PRNGKey(7)
+
+        grads = jax.grad(
+            lambda p: jnp.mean(lyr.pretrain_loss_per_example(p, x, rng)))(
+                params)
+
+        # hand-computed CD-1 statistics from the same chain
+        h0, vk, hk = lyr._gibbs_chain(params, x, rng)
+        B = x.shape[0]
+        w_expect = -(jnp.dot(x.T, h0) - jnp.dot(vk.T, hk)) / B
+        hb_expect = -jnp.mean(h0 - hk, axis=0)
+        vb_expect = -jnp.mean(x - vk, axis=0)
+        np.testing.assert_allclose(np.asarray(grads["W"]),
+                                   np.asarray(w_expect), atol=1e-12)
+        np.testing.assert_allclose(np.asarray(grads["b"]),
+                                   np.asarray(hb_expect), atol=1e-12)
+        np.testing.assert_allclose(np.asarray(grads["vb"]),
+                                   np.asarray(vb_expect), atol=1e-12)
+
+    def test_sparsity_replaces_hidden_bias_phase(self):
+        """reference :173-175: sparsity != 0 makes the hb gradient
+        -(sparsity - h0_prob); W and vb statistics are unchanged."""
+        lyr, params = _rbm_params()
+        sparse = RBM(n_in=6, n_out=4, sparsity=0.1, bias_init=0.0)
+        rs = np.random.RandomState(4)
+        x = jnp.asarray(rs.rand(5, 6) > 0.5, jnp.float64)
+        rng = jax.random.PRNGKey(1)
+
+        g_plain = jax.grad(
+            lambda p: jnp.mean(lyr.pretrain_loss_per_example(p, x, rng)))(
+                params)
+        g_sparse = jax.grad(
+            lambda p: jnp.mean(sparse.pretrain_loss_per_example(p, x, rng)))(
+                params)
+        h0, _, _ = lyr._gibbs_chain(params, x, rng)
+        hb_expect = -jnp.mean(0.1 - h0, axis=0)
+        np.testing.assert_allclose(np.asarray(g_sparse["b"]),
+                                   np.asarray(hb_expect), atol=1e-12)
+        np.testing.assert_allclose(np.asarray(g_sparse["W"]),
+                                   np.asarray(g_plain["W"]), atol=1e-12)
+        np.testing.assert_allclose(np.asarray(g_sparse["vb"]),
+                                   np.asarray(g_plain["vb"]), atol=1e-12)
+
+    def test_cdk_chain_length(self):
+        """k>1 runs a longer chain: the negative statistics differ from
+        CD-1's but stay finite and shape-correct."""
+        lyr, params = _rbm_params()
+        deep = RBM(n_in=6, n_out=4, k=3, bias_init=0.0)
+        rs = np.random.RandomState(5)
+        x = jnp.asarray(rs.rand(4, 6) > 0.5, jnp.float64)
+        rng = jax.random.PRNGKey(2)
+        g1 = jax.grad(
+            lambda p: jnp.mean(lyr.pretrain_loss_per_example(p, x, rng)))(
+                params)
+        g3 = jax.grad(
+            lambda p: jnp.mean(deep.pretrain_loss_per_example(p, x, rng)))(
+                params)
+        assert all(np.isfinite(np.asarray(g3[k])).all() for k in g3)
+        assert not np.allclose(np.asarray(g1["W"]), np.asarray(g3["W"]))
+
+    @pytest.mark.parametrize("hidden,visible", [
+        ("rectified", "gaussian"), ("gaussian", "linear"),
+        ("identity", "identity")])
+    def test_unit_variants_finite(self, hidden, visible):
+        lyr = RBM(n_in=6, n_out=4, hidden_unit=hidden,
+                  visible_unit=visible, bias_init=0.0)
+        params = lyr.init_params(jax.random.PRNGKey(0), dtype=jnp.float64)
+        rs = np.random.RandomState(6)
+        x = jnp.asarray(rs.randn(4, 6) * 0.5, jnp.float64)
+        g = jax.grad(
+            lambda p: jnp.mean(lyr.pretrain_loss_per_example(
+                p, x, jax.random.PRNGKey(3))))(params)
+        assert all(np.isfinite(np.asarray(g[k])).all() for k in g)
+
+    def test_validate_rejects_bad_units(self):
+        with pytest.raises(ValueError, match="hidden_unit"):
+            RBM(n_in=2, n_out=2, hidden_unit="softmax").validate()
+        with pytest.raises(ValueError, match="visible_unit"):
+            RBM(n_in=2, n_out=2, visible_unit="softmax").validate()
+        with pytest.raises(ValueError, match="k must be"):
+            RBM(n_in=2, n_out=2, k=0).validate()
+
+
+class TestPretraining:
+    def _patterned_data(self, n=128, seed=0):
+        """Two binary prototype patterns + flip noise: an RBM with a few
+        hidden units can model this well, so CD-1 must drive recon error
+        down."""
+        rs = np.random.RandomState(seed)
+        protos = np.array([[1, 1, 1, 0, 0, 0, 1, 0],
+                           [0, 0, 0, 1, 1, 1, 0, 1]], np.float64)
+        x = protos[rs.randint(0, 2, n)]
+        flip = rs.rand(n, 8) < 0.05
+        return np.where(flip, 1 - x, x)
+
+    def test_pretrain_reduces_reconstruction_error(self):
+        x = self._patterned_data()
+        conf = (NeuralNetConfiguration.builder().seed(12)
+                .updater(Sgd(learning_rate=0.5))
+                .list(RBM(n_out=4),
+                      OutputLayer(n_out=2, activation="softmax",
+                                  loss="mcxent"))
+                .set_input_type(InputType.feed_forward(8)).build())
+        net = MultiLayerNetwork(conf).init()
+        lyr = net.layers[0]
+
+        def recon_err(params):
+            h = lyr.prop_up(params["0"], jnp.asarray(x))
+            v = lyr.prop_down(params["0"], h)
+            return float(jnp.mean((jnp.asarray(x) - v) ** 2))
+
+        before = recon_err(net.params)
+        net.pretrain(DataSet(x, None), epochs=60)
+        after = recon_err(net.params)
+        assert after < before * 0.5, (before, after)
+
+    def test_supervised_gradcheck_through_rbm_forward(self):
+        """After pretraining, the RBM acts as a feed-forward layer
+        (propUp); the supervised backprop through it must pass the central
+        finite-difference check like any other layer."""
+        rng = np.random.default_rng(1)
+        conf = (NeuralNetConfiguration.builder().seed(42)
+                .updater(Sgd(learning_rate=0.1)).weight_init("xavier")
+                .dtype("float64")
+                .list(RBM(n_out=5),
+                      OutputLayer(n_out=3, activation="softmax",
+                                  loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        x = rng.normal(0, 1, (5, 4))
+        y = np.eye(3)[rng.integers(0, 3, 5)]
+        assert check_gradients(net, x, y)
+
+
+class TestSerde:
+    def test_json_round_trip(self):
+        lyr = RBM(n_in=6, n_out=4, hidden_unit="rectified",
+                  visible_unit="gaussian", k=3, sparsity=0.05)
+        back = from_json(to_json(lyr))
+        assert back == lyr
