@@ -52,6 +52,47 @@ def _row_mean_scale(num_rows, idx, weights, cap):
     return jnp.minimum(1.0, cap / jnp.maximum(cnt[idx], 1.0))
 
 
+def _segment_row_add(row_idx, updates, weights, cap, stacked):
+    """Add ``updates`` into ``stacked`` rows WITHOUT a duplicate-index
+    scatter: sort by destination row, per-row-count dup_cap scale, segment
+    sums, then ONE scatter whose indices are provably sorted and unique.
+
+    Rationale (TPU): XLA lowers a scatter-add with possibly-duplicate
+    indices to a serialized per-row loop — the measured round-3 word2vec
+    bottleneck (6 such scatters per 8192-pair batch). Sorting first costs
+    one 32-bit argsort + two segment sums (both parallel) and converts the
+    scatter into the unique+sorted form the backend can vectorize.
+    Numerically identical to the `.at[].add` path up to float summation
+    order (same per-element min(1, cap/count) scale as _row_mean_scale).
+
+    row_idx [M] int32; updates [M, D] pre-masked (weight-0 elements carry a
+    zero update); weights [M] (0 = padding); cap scalar or per-element [M]
+    (label rows train uncapped while word rows stay capped); stacked
+    [R, D]. Segments that do not exist land on distinct dummy rows past R
+    (zero contribution), so indices stay unique without a dynamic segment
+    count."""
+    M, D = updates.shape
+    R = stacked.shape[0]
+    order = jnp.argsort(row_idx)
+    si = row_idx[order]
+    su = updates[order]
+    sw = weights[order]
+    sc = jnp.broadcast_to(cap, (M,))[order]
+    start = jnp.concatenate([jnp.ones((1,), bool), si[1:] != si[:-1]])
+    seg = jnp.cumsum(start.astype(jnp.int32)) - 1
+    cnt = jax.ops.segment_sum(sw, seg, num_segments=M)
+    scale = jnp.minimum(1.0, sc / jnp.maximum(cnt[seg], 1.0))
+    summed = jax.ops.segment_sum(su * scale[:, None], seg, num_segments=M)
+    nseg = jnp.sum(start.astype(jnp.int32))
+    rep = jax.ops.segment_max(si, seg, num_segments=M)
+    j = jnp.arange(M)
+    rep = jnp.where(j < nseg, rep, R + j)
+    padded = jnp.concatenate([stacked, jnp.zeros((M, D), stacked.dtype)])
+    padded = padded.at[rep].add(summed, indices_are_sorted=True,
+                                unique_indices=True)
+    return padded[:R]
+
+
 @partial(jax.jit, static_argnames=("use_hs", "use_ns"))
 def skipgram_step(syn0, syn1, syn1neg, centers, points, codes, code_mask,
                   neg_targets, neg_labels, lr, dup_cap, *, use_hs: bool,
@@ -96,12 +137,14 @@ def skipgram_step(syn0, syn1, syn1neg, centers, points, codes, code_mask,
 
 
 @partial(jax.jit,
-         static_argnames=("window", "batch", "neg_k", "use_hs", "use_ns"),
+         static_argnames=("window", "batch", "neg_k", "use_hs", "use_ns",
+                          "segment_updates"),
          donate_argnums=(0, 1, 2))
 def skipgram_corpus_epoch(syn0, syn1, syn1neg, tokens, key,
                           lr_start, lr_end, dup_cap, points_tab, codes_tab,
                           cmask_tab, neg_table, *, window: int, batch: int,
-                          neg_k: int, use_hs: bool, use_ns: bool):
+                          neg_k: int, use_hs: bool, use_ns: bool,
+                          segment_updates: bool = True):
     """One skipgram epoch generated AND trained on device.
 
     The round-3 v1 fast path staged pre-built pair/negative batches from
@@ -153,14 +196,37 @@ def skipgram_corpus_epoch(syn0, syn1, syn1neg, tokens, key,
     pred = jnp.maximum(pred, 0).reshape(S, batch)
     pm = val.reshape(S, batch).astype(syn0.dtype)
     lrs = jnp.linspace(lr_start, lr_end, S).astype(syn0.dtype)
+    return _pair_scan(syn0, syn1, syn1neg, rows, pred, pm, lrs, kn,
+                      points_tab, codes_tab, cmask_tab, neg_table, dup_cap,
+                      dup_cap, batch=batch, neg_k=neg_k, use_hs=use_hs,
+                      use_ns=use_ns, segment_updates=segment_updates)
+
+
+def _pair_scan(syn0, syn1, syn1neg, rows, pred, pm, lrs, kn, points_tab,
+               codes_tab, cmask_tab, neg_table, dup_cap, syn0_cap, *,
+               batch: int, neg_k: int, use_hs: bool, use_ns: bool,
+               segment_updates: bool):
+    """The skipgram family's inner loop: scan over [S, B] (row, predicted)
+    pair batches. ``rows`` move in syn0 (skipgram: context words; DBOW: doc
+    labels); ``pred`` supply the HS path / NS positive. syn0_cap is the
+    dup-cap for syn0 row updates, separate from the table cap so label
+    training (one row in every pair of a batch) can run uncapped
+    (syn0_cap=inf) while hot word targets stay stabilised."""
     V = syn0.shape[0]
+    V1 = syn1.shape[0]
     tsize = neg_table.shape[0]
+    S = rows.shape[0]
 
     def body(carry, xs):
         syn0, syn1, syn1neg = carry
         c, p_idx, pm_b, lr, i = xs
         h = syn0[c]
         grad_h = jnp.zeros_like(h)
+        # segment_updates=True: collect (destination row in the STACKED
+        # [syn0; syn1; syn1neg] row space, update, weight, cap) tuples and
+        # apply them in one sorted-unique scatter at the end (see
+        # _segment_row_add); False keeps the plain scatter-adds for A/B.
+        idx_parts, upd_parts, w_parts, cap_parts = [], [], [], []
         if use_hs:
             pts = points_tab[p_idx]                # [B, L]
             cd = codes_tab[p_idx]
@@ -169,9 +235,15 @@ def skipgram_corpus_epoch(syn0, syn1, syn1neg, tokens, key,
             f = jax.nn.sigmoid(jnp.einsum("bd,bld->bl", h, w1))
             g = (1.0 - cd - f) * cm * lr
             grad_h = grad_h + jnp.einsum("bl,bld->bd", g, w1)
-            s1 = _row_mean_scale(V, pts, cm, dup_cap)
-            syn1 = syn1.at[pts].add(jnp.einsum("bl,bd->bld", g, h)
-                                    * s1[..., None])
+            dw1 = jnp.einsum("bl,bd->bld", g, h)
+            if segment_updates:
+                idx_parts.append(pts.reshape(-1) + V)
+                upd_parts.append(dw1.reshape(-1, h.shape[1]))
+                w_parts.append(cm.reshape(-1))
+                cap_parts.append(jnp.full((pts.size,), dup_cap, syn0.dtype))
+            else:
+                s1 = _row_mean_scale(V, pts, cm, dup_cap)
+                syn1 = syn1.at[pts].add(dw1 * s1[..., None])
         if use_ns:
             draws = jax.random.randint(jax.random.fold_in(kn, i),
                                        (batch, neg_k), 0, tsize,
@@ -182,13 +254,35 @@ def skipgram_corpus_epoch(syn0, syn1, syn1neg, tokens, key,
             f = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", h, wn))
             g = (nl - f) * pm_b[:, None] * lr
             grad_h = grad_h + jnp.einsum("bk,bkd->bd", g, wn)
-            sn = _row_mean_scale(V, nt,
-                                 jnp.broadcast_to(pm_b[:, None], nt.shape),
-                                 dup_cap)
-            syn1neg = syn1neg.at[nt].add(jnp.einsum("bk,bd->bkd", g, h)
-                                         * sn[..., None])
-        s0 = _row_mean_scale(V, c, pm_b, dup_cap)
-        syn0 = syn0.at[c].add(grad_h * s0[:, None])
+            dwn = jnp.einsum("bk,bd->bkd", g, h)
+            if segment_updates:
+                idx_parts.append(nt.reshape(-1) + (V + V1))
+                upd_parts.append(dwn.reshape(-1, h.shape[1]))
+                w_parts.append(
+                    jnp.broadcast_to(pm_b[:, None], nt.shape).reshape(-1))
+                cap_parts.append(jnp.full((nt.size,), dup_cap, syn0.dtype))
+            else:
+                sn = _row_mean_scale(V, nt,
+                                     jnp.broadcast_to(pm_b[:, None],
+                                                      nt.shape),
+                                     dup_cap)
+                syn1neg = syn1neg.at[nt].add(dwn * sn[..., None])
+        if segment_updates:
+            idx_parts.append(c)
+            upd_parts.append(grad_h)
+            w_parts.append(pm_b)
+            cap_parts.append(jnp.full((c.shape[0],), syn0_cap, syn0.dtype))
+            stacked = jnp.concatenate([syn0, syn1, syn1neg], 0)
+            stacked = _segment_row_add(jnp.concatenate(idx_parts),
+                                       jnp.concatenate(upd_parts),
+                                       jnp.concatenate(w_parts),
+                                       jnp.concatenate(cap_parts), stacked)
+            syn0 = stacked[:V]
+            syn1 = stacked[V:V + V1]
+            syn1neg = stacked[V + V1:]
+        else:
+            s0 = _row_mean_scale(V, c, pm_b, syn0_cap)
+            syn0 = syn0.at[c].add(grad_h * s0[:, None])
         return (syn0, syn1, syn1neg), None
 
     (syn0, syn1, syn1neg), _ = jax.lax.scan(
@@ -240,6 +334,174 @@ def cbow_step(syn0, syn1, syn1neg, context, context_mask, points, codes,
     sc = _row_mean_scale(V, context, context_mask, dup_cap)
     syn0 = syn0.at[context].add(per_ctx * sc[..., None])
     return syn0, syn1, syn1neg
+
+
+@partial(jax.jit,
+         static_argnames=("window", "batch", "neg_k", "use_hs", "use_ns",
+                          "with_labels", "segment_updates"),
+         donate_argnums=(0, 1, 2))
+def cbow_corpus_epoch(syn0, syn1, syn1neg, tokens, labels, key, lr_start,
+                      lr_end, dup_cap, label_cap, points_tab, codes_tab,
+                      cmask_tab, neg_table, *, window: int, batch: int,
+                      neg_k: int, use_hs: bool, use_ns: bool,
+                      with_labels: bool, segment_updates: bool = True):
+    """One CBOW epoch on device — and, with_labels=True, one doc2vec DM
+    epoch (reference: elements/CBOW.java, sequence/DM.java).
+
+    Same token-stream-only contract as ``skipgram_corpus_epoch`` (tokens
+    [N] with -1 separators, N % batch == 0), with the roles flipped: every
+    position is a CENTER whose context is the 2W shifted views; the
+    masked context mean predicts the center. ``labels`` [N] carries a
+    syn0 row id per position (-1 = none) and is prepended as an extra
+    always-on context slot — the DM trick, streamed. The label slot's
+    dup-cap is ``label_cap`` (inf for label training: one row per doc
+    appears in EVERY window of that doc; capping would attenuate its
+    gradient ~batch/cap-fold), word slots keep ``dup_cap``.
+    """
+    N = tokens.shape[0]
+    W = window
+    kw, kn = jax.random.split(key)
+    win = jax.random.randint(kw, (N,), 1, W + 1, dtype=jnp.int32)
+    sent_id = jnp.cumsum((tokens < 0).astype(jnp.int32))
+    tok_pad = jnp.pad(tokens, W, constant_values=-1)
+    sid_pad = jnp.pad(sent_id, W, constant_values=-2)
+    ctxs, valids = [], []
+    for d in range(-W, W + 1):
+        if d == 0:
+            continue
+        ctx_d = jax.lax.dynamic_slice(tok_pad, (W + d,), (N,))
+        sid_d = jax.lax.dynamic_slice(sid_pad, (W + d,), (N,))
+        valids.append((sid_d == sent_id) & (jnp.abs(d) <= win)
+                      & (tokens >= 0) & (ctx_d >= 0))
+        ctxs.append(ctx_d)
+    ctx = jnp.stack(ctxs, 1)                       # [N, 2W]
+    val = jnp.stack(valids, 1)
+    if with_labels:
+        ctx = jnp.concatenate([labels[:, None], ctx], 1)
+        val = jnp.concatenate([((labels >= 0) & (tokens >= 0))[:, None],
+                               val], 1)
+    C = ctx.shape[1]
+    S = N // batch
+    V = syn0.shape[0]
+    V1 = syn1.shape[0]
+    tsize = neg_table.shape[0]
+    ctx_b = jnp.maximum(ctx, 0).reshape(S, batch, C)
+    # center is trainable iff in-vocab with >=1 live context slot; context
+    # slots are additionally masked by their center's validity
+    pm = ((tokens >= 0) & val.any(axis=1)).astype(syn0.dtype)
+    cm_b = (val.astype(syn0.dtype) * pm[:, None]).reshape(S, batch, C)
+    pm_b = pm.reshape(S, batch)
+    cen_b = jnp.maximum(tokens, 0).reshape(S, batch)
+    lrs = jnp.linspace(lr_start, lr_end, S).astype(syn0.dtype)
+    if with_labels:
+        slot_cap = jnp.concatenate(
+            [jnp.broadcast_to(label_cap, (1,)).astype(syn0.dtype),
+             jnp.full((C - 1,), 1.0, syn0.dtype) * dup_cap])
+    else:
+        slot_cap = jnp.full((C,), 1.0, syn0.dtype) * dup_cap
+
+    def body(carry, xs):
+        syn0, syn1, syn1neg = carry
+        cx, cm, p_idx, pm_b, lr, i = xs
+        denom = jnp.maximum(cm.sum(axis=1, keepdims=True), 1.0)
+        h = (syn0[cx] * cm[..., None]).sum(axis=1) / denom    # [B, D]
+        grad_h = jnp.zeros_like(h)
+        idx_parts, upd_parts, w_parts, cap_parts = [], [], [], []
+        if use_hs:
+            pts = points_tab[p_idx]
+            cd = codes_tab[p_idx]
+            hm = cmask_tab[p_idx] * pm_b[:, None]
+            w1 = syn1[pts]
+            f = jax.nn.sigmoid(jnp.einsum("bd,bld->bl", h, w1))
+            g = (1.0 - cd - f) * hm * lr
+            grad_h = grad_h + jnp.einsum("bl,bld->bd", g, w1)
+            dw1 = jnp.einsum("bl,bd->bld", g, h)
+            if segment_updates:
+                idx_parts.append(pts.reshape(-1) + V)
+                upd_parts.append(dw1.reshape(-1, h.shape[1]))
+                w_parts.append(hm.reshape(-1))
+                cap_parts.append(jnp.full((pts.size,), 1.0, syn0.dtype)
+                                 * dup_cap)
+            else:
+                s1 = _row_mean_scale(V, pts, hm, dup_cap)
+                syn1 = syn1.at[pts].add(dw1 * s1[..., None])
+        if use_ns:
+            draws = jax.random.randint(jax.random.fold_in(kn, i),
+                                       (batch, neg_k), 0, tsize,
+                                       dtype=jnp.int32)
+            nt = jnp.concatenate([p_idx[:, None], neg_table[draws]], axis=1)
+            nl = jnp.zeros((batch, 1 + neg_k), syn0.dtype).at[:, 0].set(1.0)
+            wn = syn1neg[nt]
+            f = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", h, wn))
+            g = (nl - f) * pm_b[:, None] * lr
+            grad_h = grad_h + jnp.einsum("bk,bkd->bd", g, wn)
+            dwn = jnp.einsum("bk,bd->bkd", g, h)
+            if segment_updates:
+                idx_parts.append(nt.reshape(-1) + (V + V1))
+                upd_parts.append(dwn.reshape(-1, h.shape[1]))
+                w_parts.append(
+                    jnp.broadcast_to(pm_b[:, None], nt.shape).reshape(-1))
+                cap_parts.append(jnp.full((nt.size,), 1.0, syn0.dtype)
+                                 * dup_cap)
+            else:
+                sn = _row_mean_scale(V, nt,
+                                     jnp.broadcast_to(pm_b[:, None],
+                                                      nt.shape),
+                                     dup_cap)
+                syn1neg = syn1neg.at[nt].add(dwn * sn[..., None])
+        # spread the input gradient over contributing context slots
+        per_ctx = (grad_h[:, None, :] * cm[..., None]) / denom[..., None]
+        cap_b = jnp.broadcast_to(slot_cap[None, :], cm.shape)
+        if segment_updates:
+            idx_parts.append(cx.reshape(-1))
+            upd_parts.append(per_ctx.reshape(-1, h.shape[1]))
+            w_parts.append(cm.reshape(-1))
+            cap_parts.append(cap_b.reshape(-1))
+            stacked = jnp.concatenate([syn0, syn1, syn1neg], 0)
+            stacked = _segment_row_add(jnp.concatenate(idx_parts),
+                                       jnp.concatenate(upd_parts),
+                                       jnp.concatenate(w_parts),
+                                       jnp.concatenate(cap_parts), stacked)
+            syn0 = stacked[:V]
+            syn1 = stacked[V:V + V1]
+            syn1neg = stacked[V + V1:]
+        else:
+            sc = _row_mean_scale(V, cx, cm, cap_b)
+            syn0 = syn0.at[cx].add(per_ctx * sc[..., None])
+        return (syn0, syn1, syn1neg), None
+
+    (syn0, syn1, syn1neg), _ = jax.lax.scan(
+        body, (syn0, syn1, syn1neg),
+        (ctx_b, cm_b, cen_b, pm_b, lrs, jnp.arange(S, dtype=jnp.int32)))
+    return syn0, syn1, syn1neg
+
+
+@partial(jax.jit,
+         static_argnames=("batch", "neg_k", "use_hs", "use_ns",
+                          "segment_updates"),
+         donate_argnums=(0, 1, 2))
+def dbow_corpus_epoch(syn0, syn1, syn1neg, tokens, labels, key, lr_start,
+                      lr_end, dup_cap, label_cap, points_tab, codes_tab,
+                      cmask_tab, neg_table, *, batch: int, neg_k: int,
+                      use_hs: bool, use_ns: bool,
+                      segment_updates: bool = True):
+    """One doc2vec DBOW epoch on device (reference: sequence/DBOW.java):
+    the document's label row predicts every document word — the skipgram
+    inner loop with rows = ``labels`` [N] (syn0 row per position, -1 =
+    none) and predicted = ``tokens``. Label syn0 updates run with
+    ``label_cap`` (inf: full-batch gradient on the one moving row); word
+    HS/NS tables keep ``dup_cap``."""
+    N = tokens.shape[0]
+    S = N // batch
+    _, kn = jax.random.split(key)
+    pm = ((tokens >= 0) & (labels >= 0)).astype(syn0.dtype).reshape(S, batch)
+    rows = jnp.maximum(labels, 0).reshape(S, batch)
+    pred = jnp.maximum(tokens, 0).reshape(S, batch)
+    lrs = jnp.linspace(lr_start, lr_end, S).astype(syn0.dtype)
+    return _pair_scan(syn0, syn1, syn1neg, rows, pred, pm, lrs, kn,
+                      points_tab, codes_tab, cmask_tab, neg_table, dup_cap,
+                      label_cap, batch=batch, neg_k=neg_k, use_hs=use_hs,
+                      use_ns=use_ns, segment_updates=segment_updates)
 
 
 class BatchBuilder:

@@ -20,7 +20,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu.nlp.learning import skipgram_step
 from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
 from deeplearning4j_tpu.nlp.vocab import Huffman, VocabWord
 
@@ -51,6 +50,14 @@ class ParagraphVectors(SequenceVectors):
     def __init__(self, sequence_algorithm: str = "dbow",
                  train_words: bool = False, **kw):
         kw.setdefault("elements_algorithm", "skipgram")
+        # doc2vec batches must stay SMALL: every position of a document
+        # carries the same label row, so a large batch sums hundreds of
+        # stale-read label contributions into one step and distinct labels
+        # collapse toward a common direction (measured: 2-label corpus,
+        # batch 512 -> label cosine 0.99 and broken classification; batch
+        # 64 -> cosine 0.19, correct). Words don't have this problem —
+        # SequenceVectors keeps its large default.
+        kw.setdefault("batch_size", 64)
         super().__init__(**kw)
         self.sequence_algorithm = sequence_algorithm.lower()
         self.train_words = train_words
@@ -75,6 +82,28 @@ class ParagraphVectors(SequenceVectors):
 
     # -------------------------------------------------------------------- fit
     def fit(self, documents) -> "ParagraphVectors":
+        """Device-resident doc2vec: the host uploads a TOKEN stream plus a
+        parallel LABEL stream (syn0 row id per position) and the whole
+        epoch runs as one jitted scan per corpus block — the same
+        transfer-minimal scheme as SequenceVectors._fit_element_epochs,
+        replacing the round-3 one-dispatch-per-document loop (measured
+        ~10-100x slower from dispatch and host pair assembly alone).
+
+        Label syn0 updates run with dup_cap=inf: one label row appears in
+        every pair/window of its document, so the duplicate cap would
+        attenuate label training ~batch/cap-fold; uncapped summation is
+        the full-batch gradient for that row against near-frozen word
+        targets (reference: sequential accumulation in DBOW.java/DM.java).
+        Multi-label documents repeat their tokens once per label, matching
+        the reference's per-label iteration."""
+        from deeplearning4j_tpu.nlp.learning import (DUP_CAP,
+                                                     cbow_corpus_epoch,
+                                                     dbow_corpus_epoch,
+                                                     skipgram_corpus_epoch)
+
+        if self.sequence_algorithm not in ("dbow", "dm"):
+            raise ValueError(
+                f"Unknown sequence algorithm '{self.sequence_algorithm}'")
         documents = list(documents)
         if self.vocab is None:
             self.build_vocab_from_documents(documents)
@@ -83,66 +112,108 @@ class ParagraphVectors(SequenceVectors):
         self._label_ids = {
             label: self.vocab.index_of(self._label_token(label))
             for d in documents for label in d.labels}
-        total = max(sum(len(d.content.split()) for d in documents), 1)
-        total *= self.epochs
-        seen = 0
-        for _ in range(self.epochs):
-            for d in documents:
-                tokens = self.tokenizer_factory.create(d.content).tokens()
-                idx = self._builder.sentence_to_indices(tokens)
-                if idx.size == 0:
-                    continue
-                lr = self._alpha(seen / total)
-                label_ids = np.asarray(
-                    [self.vocab.index_of(self._label_token(l))
-                     for l in d.labels], np.int32)
-                if self.sequence_algorithm == "dbow":
-                    self._fit_dbow(idx, label_ids, lr)
-                elif self.sequence_algorithm == "dm":
-                    self._fit_dm(idx, label_ids, lr)
+        b = self._builder
+        entries, total_tokens = [], 0
+        for d in documents:
+            tokens = self.tokenizer_factory.create(d.content).tokens()
+            idx = b.lookup_indices(tokens)
+            if idx.size == 0:
+                continue
+            for label in d.labels:
+                entries.append((idx, self._label_ids[label]))
+                total_tokens += idx.size
+        if not entries:
+            return self
+        B, W, K = self.batch_size, self.window, self.negative
+        if self.use_hs:
+            points_tab = jnp.asarray(b.points)
+            codes_tab = jnp.asarray(b.codes)
+            cmask_tab = jnp.asarray(b.code_mask)
+        else:
+            points_tab = jnp.zeros((1, 1), jnp.int32)
+            codes_tab = jnp.zeros((1, 1), jnp.float32)
+            cmask_tab = jnp.zeros((1, 1), jnp.float32)
+        neg_table = (jnp.asarray(b._neg_table) if K > 0
+                     else jnp.zeros((1,), jnp.int32))
+        total_units = max(total_tokens * self.epochs * self.iterations, 1)
+        done = 0
+        # without subsampling every pass trains on identical streams —
+        # assemble and upload them once, not once per epoch x iteration
+        static_streams = None if self.sampling > 0 else \
+            self._doc_streams(entries, B, W)
+        static_words = None
+        if self.sampling <= 0 and self.train_words:
+            static_words = self._token_stream(
+                [idx for idx, _ in entries], B, W)
+        for e in range(self.epochs):
+            for it in range(self.iterations):
+                if self.sampling > 0:
+                    ent = [(b.subsample(idx), lab) for idx, lab in entries]
+                    toks, labs = self._doc_streams(ent, B, W)
+                    words_stream = (self._token_stream(
+                        [idx for idx, _ in ent], B, W)
+                        if self.train_words else None)
                 else:
-                    raise ValueError(
-                        f"Unknown sequence algorithm "
-                        f"'{self.sequence_algorithm}'")
-                if self.train_words:
-                    self._train_indexed(idx, seen / total)
-                seen += idx.size
+                    toks, labs = static_streams
+                    words_stream = static_words
+                lr0 = self._alpha(min(done / total_units, 1.0))
+                lr1 = self._alpha(min((done + total_tokens) / total_units,
+                                      1.0))
+                key = jax.random.fold_in(
+                    jax.random.PRNGKey(self.seed + 7),
+                    done + e * 131071 + it)
+                done += total_tokens
+                if toks is None:
+                    continue
+                inf = jnp.float32(np.inf)
+                if self.sequence_algorithm == "dbow":
+                    self.syn0, self.syn1, self.syn1neg = dbow_corpus_epoch(
+                        self.syn0, self.syn1, self.syn1neg, toks, labs,
+                        key, jnp.float32(lr0), jnp.float32(lr1),
+                        jnp.float32(DUP_CAP), inf, points_tab, codes_tab,
+                        cmask_tab, neg_table, batch=B, neg_k=max(K, 0),
+                        use_hs=self.use_hs, use_ns=K > 0)
+                else:
+                    self.syn0, self.syn1, self.syn1neg = cbow_corpus_epoch(
+                        self.syn0, self.syn1, self.syn1neg, toks, labs,
+                        key, jnp.float32(lr0), jnp.float32(lr1),
+                        jnp.float32(DUP_CAP), inf, points_tab, codes_tab,
+                        cmask_tab, neg_table, window=W, batch=B,
+                        neg_k=max(K, 0), use_hs=self.use_hs, use_ns=K > 0,
+                        with_labels=True)
+                if self.train_words and words_stream is not None:
+                    # trainWords=true: ordinary skipgram over the same
+                    # corpus (reference: ParagraphVectors trainWords flag)
+                    self.syn0, self.syn1, self.syn1neg = \
+                        skipgram_corpus_epoch(
+                            self.syn0, self.syn1, self.syn1neg,
+                            words_stream, jax.random.fold_in(key, 1),
+                            jnp.float32(lr0), jnp.float32(lr1),
+                            jnp.float32(DUP_CAP),
+                            points_tab, codes_tab, cmask_tab, neg_table,
+                            window=W, batch=B, neg_k=max(K, 0),
+                            use_hs=self.use_hs, use_ns=K > 0)
         return self
 
-    def _fit_dbow(self, idx, label_ids, lr):
-        """Label row predicts every doc word (reference: DBOW.java).
-
-        dup_cap=inf: the whole batch moves ONE label row, so the duplicate
-        cap would attenuate label training ~batch/16-fold; uncapped
-        summation is the full-batch gradient for that single row against
-        near-frozen word targets — stable, and matches the reference's
-        sequential accumulation."""
-        for lab in label_ids:
-            rows = np.full(idx.size, lab, np.int32)
-            for s in range(0, idx.size, self.batch_size):
-                sl = slice(s, s + self.batch_size)
-                self._skipgram_batch(rows[sl], idx[sl], lr,
-                                     dup_cap=float("inf"))
-
-    def _train_indexed(self, idx, progress):
-        """trainWords=true: ordinary skipgram over the document's words
-        (reference: ParagraphVectors trainWords flag). Sliced to batch_size
-        like _fit_dbow so XLA shapes stay bounded instead of specialising
-        on every document's pair count."""
-        centers, contexts = self._builder.pairs_from_sentence(idx)
-        lr = self._alpha(progress)
-        for s in range(0, centers.size, self.batch_size):
-            sl = slice(s, s + self.batch_size)
-            self._skipgram_batch(contexts[sl], centers[sl], lr)
-
-    def _fit_dm(self, idx, label_ids, lr):
-        """Label + window context predicts center (reference: DM.java).
-        dup_cap=inf for the same reason as DBOW (label id appears in every
-        context window)."""
-        for lab in label_ids:
-            extra = np.full(idx.size, lab, np.int32)
-            self._cbow_sentence(idx, lr, extra_context=extra,
-                                dup_cap=float("inf"))
+    @classmethod
+    def _doc_streams(cls, entries, batch: int, window: int):
+        """Parallel (token, label-row) streams with -1 separators, padded
+        to the 'positions' bucket (N % batch == 0)."""
+        tparts, lparts = [], []
+        for idx, lab in entries:
+            if idx.size:
+                tparts.append(idx.astype(np.int32))
+                tparts.append(np.full(1, -1, np.int32))
+                lparts.append(np.full(idx.size, lab, np.int32))
+                lparts.append(np.full(1, -1, np.int32))
+        if not tparts:
+            return None, None
+        t = np.concatenate(tparts)
+        lab = np.concatenate(lparts)
+        n = cls._bucket_size(t.size, batch, window, "positions")
+        pad = np.full(n - t.size, -1, np.int32)
+        return (jnp.asarray(np.concatenate([t, pad])),
+                jnp.asarray(np.concatenate([lab, pad])))
 
     # ------------------------------------------------------------- inference
     def infer_vector(self, text: str, learning_rate: float = 0.01,

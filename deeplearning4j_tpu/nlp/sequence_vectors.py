@@ -25,9 +25,8 @@ import numpy as np
 from deeplearning4j_tpu.nlp.learning import (
     DUP_CAP,
     BatchBuilder,
-    cbow_step,
+    cbow_corpus_epoch,
     skipgram_corpus_epoch,
-    skipgram_step,
 )
 from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
 from deeplearning4j_tpu.nlp.vocab import AbstractCache, VocabConstructor
@@ -101,29 +100,13 @@ class SequenceVectors:
             self.build_vocab(sentences)
         if self.syn0 is None:
             self.reset_weights()
-        if self.elements_algorithm == "skipgram":
-            return self._fit_skipgram_epochs(sentences)
-        if self.elements_algorithm != "cbow":
+        if self.elements_algorithm not in ("skipgram", "cbow"):
             raise ValueError("Unknown elements algorithm "
                              f"'{self.elements_algorithm}'")
-        total_words = max(self.vocab.total_word_count, 1.0)
-        total_expected = total_words * self.epochs * self.iterations
-        seen = 0.0
-        for _ in range(self.epochs):
-            if hasattr(sentences, "reset"):
-                sentences.reset()
-            for sentence in sentences:
-                tokens = self.tokenizer_factory.create(sentence).tokens() \
-                    if isinstance(sentence, str) else list(sentence)
-                idx = self._builder.sentence_to_indices(tokens)
-                for _ in range(self.iterations):
-                    self._cbow_sentence(
-                        idx, self._alpha(seen / total_expected))
-                seen += idx.size
-        return self
+        return self._fit_element_epochs(sentences)
 
-    def _fit_skipgram_epochs(self, sentences) -> "SequenceVectors":
-        """Device-resident skipgram training, transfer-minimal: the host
+    def _fit_element_epochs(self, sentences) -> "SequenceVectors":
+        """Device-resident skipgram/CBOW training, transfer-minimal: the host
         uploads only the TOKEN STREAM (4 bytes/token, -1 sentence
         separators); pair generation, negative sampling, huffman-path
         gathers, and the whole batched update scan run inside ONE jitted
@@ -179,7 +162,9 @@ class SequenceVectors:
                     # device from the per-call rng key
                     sent_idx = [b.subsample(sid) for sid in block] \
                         if self.sampling > 0 else block
-                    stream = self._token_stream(sent_idx, B, W)
+                    mode = ("pairs" if self.elements_algorithm == "skipgram"
+                            else "positions")
+                    stream = self._token_stream(sent_idx, B, W, mode=mode)
                     if stream is None:
                         continue
                     raw = sum(sid.size for sid in block)
@@ -188,22 +173,65 @@ class SequenceVectors:
                     key = jax.random.fold_in(
                         jax.random.PRNGKey(self.seed + 1),
                         done + e * 131071 + it)
-                    self.syn0, self.syn1, self.syn1neg = \
-                        skipgram_corpus_epoch(
-                            self.syn0, self.syn1, self.syn1neg,
-                            stream, key, jnp.float32(lr0),
-                            jnp.float32(lr1), jnp.float32(DUP_CAP),
-                            points_tab, codes_tab, cmask_tab, neg_table,
-                            window=W, batch=B, neg_k=max(K, 0),
-                            use_hs=self.use_hs, use_ns=K > 0)
+                    if self.elements_algorithm == "skipgram":
+                        self.syn0, self.syn1, self.syn1neg = \
+                            skipgram_corpus_epoch(
+                                self.syn0, self.syn1, self.syn1neg,
+                                stream, key, jnp.float32(lr0),
+                                jnp.float32(lr1), jnp.float32(DUP_CAP),
+                                points_tab, codes_tab, cmask_tab, neg_table,
+                                window=W, batch=B, neg_k=max(K, 0),
+                                use_hs=self.use_hs, use_ns=K > 0)
+                    else:
+                        self.syn0, self.syn1, self.syn1neg = \
+                            cbow_corpus_epoch(
+                                self.syn0, self.syn1, self.syn1neg,
+                                stream, stream, key, jnp.float32(lr0),
+                                jnp.float32(lr1), jnp.float32(DUP_CAP),
+                                jnp.float32(DUP_CAP),
+                                points_tab, codes_tab, cmask_tab, neg_table,
+                                window=W, batch=B, neg_k=max(K, 0),
+                                use_hs=self.use_hs, use_ns=K > 0,
+                                with_labels=False)
                     done += raw
         return self
 
-    @staticmethod
-    def _token_stream(sent_idx, batch: int, window: int):
+    # Above this size, stream shapes snap to multiples of it instead of
+    # powers of two: pow2 rounding wastes up to 50% of the scan on -1
+    # padding for large corpora (a 2.1M-token block would pad to 4.2M),
+    # while quantum rounding caps waste at Q/size (<7%) and still bounds
+    # the number of compiled shapes.
+    _STREAM_QUANTUM = 1 << 17
+
+    @classmethod
+    def _bucket_size(cls, size: int, batch: int, window: int,
+                     mode: str) -> int:
+        """Bucketed stream length N: powers of two below _STREAM_QUANTUM
+        (small corpora, tests), multiples of it above (large corpora) —
+        logarithmic-then-linear shape count, bounded padding waste either
+        way. mode 'pairs' (skipgram: N*2W pairs reshape to batches) needs
+        N*2W % batch == 0; 'positions' (CBOW/DBOW: one unit per position)
+        needs N % batch == 0."""
+        def ok(n):
+            return ((n * 2 * window) % batch == 0 if mode == "pairs"
+                    else n % batch == 0)
+
+        q = cls._STREAM_QUANTUM
+        if size <= q:
+            n = max(int(batch), 2)
+            while n < size or not ok(n):
+                n *= 2
+        else:
+            n = ((size + q - 1) // q) * q
+            while not ok(n):
+                n += q
+        return n
+
+    @classmethod
+    def _token_stream(cls, sent_idx, batch: int, window: int,
+                      mode: str = "pairs"):
         """Concatenate sentences with -1 separators, pad with -1 to the
-        smallest power-of-two N >= batch with N*2W % batch == 0 (bounds the
-        number of compiled program shapes)."""
+        bucketed length (see _bucket_size)."""
         parts = []
         for sid in sent_idx:
             if sid.size:
@@ -212,67 +240,13 @@ class SequenceVectors:
         if not parts:
             return None
         stream = np.concatenate(parts)
-        n = max(int(batch), 2)
-        while n < stream.size or (n * 2 * window) % batch:
-            n *= 2
+        n = cls._bucket_size(stream.size, batch, window, mode)
         return jnp.asarray(np.concatenate(
             [stream, np.full(n - stream.size, -1, np.int32)]))
 
     def _alpha(self, progress: float) -> float:
         return max(self.min_learning_rate,
                    self.learning_rate * (1.0 - progress))
-
-    def _skipgram_batch(self, rows: np.ndarray, predicted: np.ndarray,
-                        lr: float, dup_cap: float = DUP_CAP) -> None:
-        """rows: syn0 rows to move (context words); predicted: words whose
-        huffman path / positive NS target is used (reference
-        SkipGram.iterateSample(currentWord=predicted, lastWord=row)).
-        dup_cap=inf restores pure summation (doc2vec label training)."""
-        b = self._builder
-        points, codes, mask = b.hs_arrays(predicted)
-        negs = b.sample_negatives(predicted)
-        self.syn0, self.syn1, self.syn1neg = skipgram_step(
-            self.syn0, self.syn1, self.syn1neg, jnp.asarray(rows),
-            jnp.asarray(points), jnp.asarray(codes), jnp.asarray(mask),
-            jnp.asarray(negs), jnp.asarray(b.neg_labels(rows.size)),
-            jnp.float32(lr), jnp.float32(dup_cap),
-            use_hs=self.use_hs, use_ns=self.negative > 0)
-
-    def _cbow_sentence(self, idx: np.ndarray, lr: float,
-                       extra_context: Optional[np.ndarray] = None,
-                       dup_cap: float = DUP_CAP) -> None:
-        """Assemble [B, C] context windows per center word, one jitted step.
-        ``extra_context`` (e.g. a paragraph label id per sequence) is
-        prepended to every window (the DM trick)."""
-        b = self._builder
-        if idx.size < 2:
-            return
-        C = 2 * self.window + (1 if extra_context is not None else 0)
-        B = idx.size
-        ctx = np.zeros((B, C), np.int32)
-        cmask = np.zeros((B, C), np.float32)
-        bs = b.rng.randint(0, self.window, size=B)
-        for i in range(B):
-            k = 0
-            if extra_context is not None:
-                ctx[i, k] = extra_context[i]
-                cmask[i, k] = 1.0
-                k += 1
-            win = self.window - bs[i]
-            for j in range(max(0, i - win), min(B, i + win + 1)):
-                if j != i and k < C:
-                    ctx[i, k] = idx[j]
-                    cmask[i, k] = 1.0
-                    k += 1
-        points, codes, mask = b.hs_arrays(idx)
-        negs = b.sample_negatives(idx)
-        self.syn0, self.syn1, self.syn1neg = cbow_step(
-            self.syn0, self.syn1, self.syn1neg, jnp.asarray(ctx),
-            jnp.asarray(cmask), jnp.asarray(points), jnp.asarray(codes),
-            jnp.asarray(mask), jnp.asarray(negs),
-            jnp.asarray(b.neg_labels(B)), jnp.float32(lr),
-            jnp.float32(dup_cap), use_hs=self.use_hs,
-            use_ns=self.negative > 0)
 
     # ------------------------------------------------------------ query API
     def word_vector(self, word: str) -> Optional[np.ndarray]:

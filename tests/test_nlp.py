@@ -256,6 +256,116 @@ class TestSkipGramGradient:
                            atol=1e-5)
 
 
+class TestSegmentUpdates:
+    """The sorted-segment row-update path must be numerically equivalent to
+    the scatter-add path it replaces (same per-row dup_cap scaling, float
+    summation order aside)."""
+
+    def test_segment_row_add_matches_scatter(self):
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nlp.learning import (_row_mean_scale,
+                                                     _segment_row_add)
+
+        rs = np.random.RandomState(0)
+        R, D, M, cap = 40, 8, 512, 4.0
+        table = jnp.asarray(rs.randn(R, D), jnp.float32)
+        idx = jnp.asarray(rs.randint(0, R, M), jnp.int32)
+        w = jnp.asarray((rs.rand(M) > 0.2), jnp.float32)
+        upd = jnp.asarray(rs.randn(M, D), jnp.float32) * w[:, None]
+        s = _row_mean_scale(R, idx, w, cap)
+        ref = table.at[idx].add(upd * s[:, None])
+        out = _segment_row_add(idx, upd, w, jnp.float32(cap), table)
+        assert np.allclose(np.asarray(ref), np.asarray(out), atol=1e-4)
+
+    def test_epoch_parity_segment_vs_scatter(self):
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nlp.learning import skipgram_corpus_epoch
+
+        rs = np.random.RandomState(2)
+        V, D, W, K, L, B = 50, 16, 3, 4, 5, 64
+        toks = rs.randint(0, V, 96).astype(np.int32)
+        toks[::13] = -1
+        n = 96
+        while (n * 2 * W) % B:
+            n *= 2
+        toks = np.concatenate([toks, np.full(n - toks.size, -1, np.int32)])
+        pts = rs.randint(0, V - 1, (V, L)).astype(np.int32)
+        cds = (rs.rand(V, L) > 0.5).astype(np.float32)
+        cmk = (rs.rand(V, L) > 0.3).astype(np.float32)
+        neg = rs.randint(0, V, 256).astype(np.int32)
+        kwargs = dict(window=W, batch=B, neg_k=K, use_hs=True, use_ns=True)
+        args = (jnp.asarray(toks), jax.random.PRNGKey(5),
+                jnp.float32(0.025), jnp.float32(0.01), jnp.float32(8.0),
+                jnp.asarray(pts), jnp.asarray(cds), jnp.asarray(cmk),
+                jnp.asarray(neg))
+
+        def run(segment):
+            syn0 = jnp.asarray(np.linspace(-1, 1, V * D).reshape(V, D),
+                               jnp.float32)
+            syn1 = jnp.zeros((V, D), jnp.float32) + 0.01
+            syn1n = jnp.zeros((V, D), jnp.float32) + 0.02
+            return skipgram_corpus_epoch(syn0, syn1, syn1n, *args,
+                                         segment_updates=segment, **kwargs)
+
+        a0, a1, an = run(True)
+        b0, b1, bn = run(False)
+        assert np.allclose(np.asarray(a0), np.asarray(b0), atol=2e-4)
+        assert np.allclose(np.asarray(a1), np.asarray(b1), atol=2e-4)
+        assert np.allclose(np.asarray(an), np.asarray(bn), atol=2e-4)
+
+    @pytest.mark.parametrize("algo", ["cbow", "dm", "dbow"])
+    def test_cbow_dbow_epoch_parity_segment_vs_scatter(self, algo):
+        """The cbow/dbow epochs keep the scatter path as the A/B reference;
+        the segment path (incl. per-slot label_cap plumbing) must match."""
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nlp.learning import (cbow_corpus_epoch,
+                                                     dbow_corpus_epoch)
+
+        rs = np.random.RandomState(4)
+        V, D, W, K, L, B = 50, 16, 3, 4, 5, 64
+        n = 128
+        toks = rs.randint(0, V - 10, n).astype(np.int32)
+        toks[::11] = -1
+        labs = np.full(n, -1, np.int32)
+        # per-"document" label rows in the top of the table
+        doc = np.cumsum(toks < 0)
+        labs = np.where(toks >= 0, V - 10 + (doc % 10), -1).astype(np.int32)
+        pts = rs.randint(0, V - 1, (V, L)).astype(np.int32)
+        cds = (rs.rand(V, L) > 0.5).astype(np.float32)
+        cmk = (rs.rand(V, L) > 0.3).astype(np.float32)
+        neg = rs.randint(0, V, 256).astype(np.int32)
+        label_cap = np.inf if algo != "cbow" else 8.0
+        common = (jnp.asarray(toks), jnp.asarray(labs),
+                  jax.random.PRNGKey(9), jnp.float32(0.025),
+                  jnp.float32(0.01), jnp.float32(8.0),
+                  jnp.float32(label_cap), jnp.asarray(pts),
+                  jnp.asarray(cds), jnp.asarray(cmk), jnp.asarray(neg))
+
+        def run(segment):
+            syn0 = jnp.asarray(np.linspace(-1, 1, V * D).reshape(V, D),
+                               jnp.float32)
+            syn1 = jnp.zeros((V, D), jnp.float32) + 0.01
+            syn1n = jnp.zeros((V, D), jnp.float32) + 0.02
+            if algo == "dbow":
+                return dbow_corpus_epoch(syn0, syn1, syn1n, *common,
+                                         batch=B, neg_k=K, use_hs=True,
+                                         use_ns=True,
+                                         segment_updates=segment)
+            return cbow_corpus_epoch(syn0, syn1, syn1n, *common,
+                                     window=W, batch=B, neg_k=K,
+                                     use_hs=True, use_ns=True,
+                                     with_labels=(algo == "dm"),
+                                     segment_updates=segment)
+
+        for a, b in zip(run(True), run(False)):
+            assert np.allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
 class TestParagraphVectors:
     def _docs(self, n=120, seed=2):
         rs = np.random.RandomState(seed)
