@@ -5,6 +5,9 @@ RegressionEvaluation.java:32, ROC.java:53, EvaluationBinary, curves/.
 """
 
 from deeplearning4j_tpu.evaluation.classification import Evaluation
+from deeplearning4j_tpu.evaluation.curves import (Histogram,
+                                                  PrecisionRecallCurve,
+                                                  RocCurve)
 from deeplearning4j_tpu.evaluation.regression import RegressionEvaluation
 from deeplearning4j_tpu.evaluation.roc import ROC, ROCBinary, ROCMultiClass
 from deeplearning4j_tpu.evaluation.binary import EvaluationBinary

@@ -14,9 +14,17 @@ import numpy as np
 
 
 class Evaluation:
-    def __init__(self, num_classes: Optional[int] = None, labels: Optional[list] = None):
+    def __init__(self, num_classes: Optional[int] = None,
+                 labels: Optional[list] = None, top_n: int = 1):
+        """top_n > 1 additionally tracks top-N accuracy (prediction counts
+        as correct if the true class is among the N highest-probability
+        classes — reference: Evaluation.java:72 ``Evaluation(int topN)``,
+        topNCorrectCount/topNTotalCount)."""
         self.num_classes = num_classes
         self.label_names = labels
+        self.top_n = int(top_n)
+        self.top_n_correct = 0
+        self.top_n_total = 0
         self.confusion: Optional[np.ndarray] = None
 
     def _ensure(self, n):
@@ -39,9 +47,16 @@ class Evaluation:
         true_idx = np.argmax(labels, axis=-1)
         pred_idx = np.argmax(predictions, axis=-1)
         np.add.at(self.confusion, (true_idx, pred_idx), 1)
+        if self.top_n > 1:
+            n = min(self.top_n, predictions.shape[-1])
+            top = np.argpartition(predictions, -n, axis=-1)[..., -n:]
+            self.top_n_correct += int((top == true_idx[..., None]).any(-1).sum())
+            self.top_n_total += int(true_idx.size)
         return self
 
     def merge(self, other: "Evaluation") -> "Evaluation":
+        self.top_n_correct += other.top_n_correct
+        self.top_n_total += other.top_n_total
         if other.confusion is None:
             return self
         if self.confusion is None:
@@ -89,6 +104,14 @@ class Evaluation:
         r = self.recall(cls)
         return 2 * p * r / (p + r) if (p + r) else 0.0
 
+    def top_n_accuracy(self) -> float:
+        """Fraction of examples whose true class is in the top-N predicted
+        (reference: Evaluation.topNAccuracy). top_n=1 == accuracy()."""
+        if self.top_n <= 1:
+            return self.accuracy()
+        return (self.top_n_correct / self.top_n_total
+                if self.top_n_total else 0.0)
+
     def false_positive_rate(self, cls: int) -> float:
         cm = self.confusion
         tp, fp, fn = self._counts()
@@ -105,6 +128,8 @@ class Evaluation:
             f" Precision: {self.precision():.4f}",
             f" Recall:    {self.recall():.4f}",
             f" F1 Score:  {self.f1():.4f}",
+        ] + ([f" Top-{self.top_n} Accuracy: {self.top_n_accuracy():.4f}"]
+             if self.top_n > 1 else []) + [
             "",
             "=========================Confusion Matrix=========================",
         ]

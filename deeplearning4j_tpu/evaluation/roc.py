@@ -78,6 +78,23 @@ class ROC:
         order = np.argsort(rec, kind="stable")
         return float(np.trapezoid(prec[order], rec[order]))
 
+    def get_roc_curve(self):
+        """Serializable curve object (reference: ROC.getRocCurve ->
+        eval/curves/RocCurve.java with toJson round-trip)."""
+        from deeplearning4j_tpu.evaluation.curves import RocCurve
+        fpr, tpr, th = self.roc_curve()
+        return RocCurve(thresholds=[float(x) for x in th],
+                        fpr=[float(x) for x in fpr],
+                        tpr=[float(x) for x in tpr])
+
+    def get_precision_recall_curve(self):
+        """Serializable curve (reference: ROC.getPrecisionRecallCurve)."""
+        from deeplearning4j_tpu.evaluation.curves import PrecisionRecallCurve
+        rec, prec, th = self.precision_recall_curve()
+        return PrecisionRecallCurve(thresholds=[float(x) for x in th],
+                                    precision=[float(x) for x in prec],
+                                    recall=[float(x) for x in rec])
+
 
 class ROCBinary:
     """Per-output independent binary ROC (reference: eval/ROCBinary.java)."""

@@ -8,8 +8,12 @@ inline-JS overview page (no external dependencies):
 - GET /train/sessions               -> session ids
 - GET /train/overview?sid=...       -> score/time series + latest norms
 - GET /train/model?sid=...          -> static model info
+- GET /train/system?sid=...         -> memory / iterations-per-second series
+- GET /train/histograms?sid=...     -> latest parameter histograms
 - POST /remoteReceive               -> RemoteUIStatsStorageRouter sink
 - GET /                             -> HTML overview (score chart via canvas)
+- GET /model /system /histograms    -> HTML pages over the JSON endpoints
+  (the TrainModule model/system/histogram tabs of deeplearning4j-play)
 """
 
 from __future__ import annotations
@@ -26,6 +30,8 @@ _PAGE = """<!doctype html><html><head><title>dl4j-tpu training UI</title>
 <style>body{font-family:sans-serif;margin:2em}canvas{border:1px solid #ccc}
 table{border-collapse:collapse}td,th{border:1px solid #ddd;padding:4px 8px}
 </style></head><body>
+<p><a href="/">overview</a> | <a href="/model">model</a> |
+<a href="/system">system</a> | <a href="/histograms">histograms</a></p>
 <h2>Training overview</h2><div id="meta"></div>
 <canvas id="score" width="800" height="300"></canvas>
 <h3>Latest parameter norms</h3><table id="norms"></table>
@@ -56,6 +62,88 @@ async function refresh(){
    ([k,v])=>'<tr><td>'+k+'</td><td>'+v.toFixed(6)+'</td></tr>').join('');
 }
 refresh();setInterval(refresh,2000);
+</script></body></html>"""
+
+_NAV = ('<p><a href="/">overview</a> | <a href="/model">model</a> | '
+        '<a href="/system">system</a> | <a href="/histograms">histograms</a>'
+        '</p>')
+
+_MODEL_PAGE = """<!doctype html><html><head><title>model</title>
+<style>body{font-family:sans-serif;margin:2em}
+table{border-collapse:collapse}td,th{border:1px solid #ddd;padding:4px 8px}
+pre{background:#f6f6f6;padding:1em;max-width:60em;overflow:auto}
+</style></head><body>""" + _NAV + """
+<h2>Model</h2><table id="info"></table>
+<h3>Configuration</h3><pre id="conf"></pre>
+<script>
+async function refresh(){
+ const sids=await (await fetch('/train/sessions')).json();
+ if(!sids.length)return;
+ const m=await (await fetch('/train/model?sid='+sids[sids.length-1])).json();
+ document.getElementById('info').innerHTML=
+  Object.entries(m).filter(([k])=>k!='config_json').map(
+   ([k,v])=>'<tr><th>'+k+'</th><td>'+JSON.stringify(v)+'</td></tr>').join('');
+ try{document.getElementById('conf').textContent=
+   JSON.stringify(JSON.parse(m.config_json||'{}'),null,2);}catch(e){}
+}
+refresh();
+</script></body></html>"""
+
+_SYSTEM_PAGE = """<!doctype html><html><head><title>system</title>
+<style>body{font-family:sans-serif;margin:2em}canvas{border:1px solid #ccc}
+</style></head><body>""" + _NAV + """
+<h2>System</h2>
+<h3>Host memory (RSS, MB)</h3><canvas id="mem" width="800" height="220"></canvas>
+<h3>Iterations / second</h3><canvas id="ips" width="800" height="220"></canvas>
+<script>
+function line(id,xs,ys){
+ const c=document.getElementById(id).getContext('2d');
+ c.clearRect(0,0,800,220);
+ if(xs.length<2)return;
+ const ymax=Math.max(...ys),ymin=Math.min(...ys);
+ c.beginPath();
+ xs.forEach((x,i)=>{
+  const px=40+(x-xs[0])/(xs[xs.length-1]-xs[0]||1)*740;
+  const py=200-(ys[i]-ymin)/((ymax-ymin)||1)*180;
+  i?c.lineTo(px,py):c.moveTo(px,py);});
+ c.strokeStyle='#06c';c.stroke();
+ c.fillText(ymax.toFixed(2),2,20);c.fillText(ymin.toFixed(2),2,205);
+}
+async function refresh(){
+ const sids=await (await fetch('/train/sessions')).json();
+ if(!sids.length)return;
+ const s=await (await fetch('/train/system?sid='+sids[sids.length-1])).json();
+ line('mem',s.iterations,s.memory_mb);
+ line('ips',s.iterations.slice(1),s.iterations_per_second.slice(1));
+}
+refresh();setInterval(refresh,2000);
+</script></body></html>"""
+
+_HISTOGRAM_PAGE = """<!doctype html><html><head><title>histograms</title>
+<style>body{font-family:sans-serif;margin:2em}canvas{border:1px solid #ccc;
+margin:4px}</style></head><body>""" + _NAV + """
+<h2>Parameter histograms (latest report)</h2><div id="charts"></div>
+<script>
+async function refresh(){
+ const sids=await (await fetch('/train/sessions')).json();
+ if(!sids.length)return;
+ const h=await (await fetch('/train/histograms?sid='+
+                            sids[sids.length-1])).json();
+ const root=document.getElementById('charts');root.innerHTML='';
+ Object.entries(h.param_histograms||{}).forEach(([name,hist])=>{
+  const div=document.createElement('div');
+  div.innerHTML='<h4>'+name+' ['+hist.min.toFixed(4)+', '+
+    hist.max.toFixed(4)+']</h4>';
+  const cv=document.createElement('canvas');cv.width=420;cv.height=120;
+  div.appendChild(cv);root.appendChild(div);
+  const c=cv.getContext('2d');
+  const n=hist.counts.length,m=Math.max(...hist.counts)||1;
+  hist.counts.forEach((v,i)=>{
+   c.fillStyle='#06c';
+   c.fillRect(i*(420/n),120-v/m*110,(420/n)-1,v/m*110);});
+ });
+}
+refresh();setInterval(refresh,3000);
 </script></body></html>"""
 
 
@@ -110,23 +198,33 @@ class UIServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _html(self, page: str):
+                body = page.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
                 u = urlparse(self.path)
                 q = parse_qs(u.query)
                 sid = q.get("sid", [None])[0]
-                if u.path == "/":
-                    body = _PAGE.encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "text/html")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                pages = {"/": _PAGE, "/model": _MODEL_PAGE,
+                         "/system": _SYSTEM_PAGE,
+                         "/histograms": _HISTOGRAM_PAGE}
+                if u.path in pages:
+                    self._html(pages[u.path])
                 elif u.path == "/train/sessions":
                     self._json(server.list_sessions())
                 elif u.path == "/train/overview":
                     self._json(server.overview(sid))
                 elif u.path == "/train/model":
                     self._json(server.model_info(sid))
+                elif u.path == "/train/system":
+                    self._json(server.system_info(sid))
+                elif u.path == "/train/histograms":
+                    self._json(server.histograms(sid))
                 else:
                     self._json({"error": "not found"}, 404)
 
@@ -181,3 +279,33 @@ class UIServer:
             if r:
                 return r["data"]
         return {}
+
+    def system_info(self, session_id) -> dict:
+        """Memory / throughput series (reference: TrainModule system tab)."""
+        iters, mem, ips = [], [], []
+        for s in self.storages:
+            for r in s.get_all_updates_after(session_id, TYPE_ID):
+                iters.append(r["data"].get("iteration"))
+                mem.append(
+                    (r["data"].get("memory_rss_bytes") or 0) / 1e6)
+                ips.append(r["data"].get("iterations_per_second"))
+        return {"iterations": iters, "memory_mb": mem,
+                "iterations_per_second": ips}
+
+    def histograms(self, session_id) -> dict:
+        """Latest collected parameter histograms (reference: TrainModule
+        histogram tab; collected by StatsListener(collect_histograms=True)).
+        'Latest' = max (timestamp, iteration) across ALL attached storages —
+        attach order must not let a stale storage shadow a live one."""
+        latest, latest_key = None, None
+        for s in self.storages:
+            for r in s.get_all_updates_after(session_id, TYPE_ID):
+                if not r["data"].get("param_histograms"):
+                    continue
+                key = (r.get("timestamp", 0),
+                       r["data"].get("iteration") or 0)
+                if latest_key is None or key > latest_key:
+                    latest, latest_key = r, key
+        return {"iteration": latest["data"]["iteration"] if latest else None,
+                "param_histograms":
+                    latest["data"]["param_histograms"] if latest else {}}

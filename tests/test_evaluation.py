@@ -1,0 +1,102 @@
+"""Evaluation unit tests: top-N accuracy vs hand-computed values and curve
+JSON serde (ports the intent of EvaluationToolsTests / EvalTest topN and
+eval/curves round-trip tests)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.evaluation import (
+    Evaluation,
+    Histogram,
+    PrecisionRecallCurve,
+    ROC,
+    RocCurve,
+)
+
+
+class TestTopN:
+    def test_top_n_matches_hand_computed(self):
+        # 4 examples, 4 classes. Probabilities constructed so that:
+        #   ex0: true 0, ranked 1st            -> top1 hit, top2 hit
+        #   ex1: true 2, ranked 2nd            -> top1 miss, top2 hit
+        #   ex2: true 1, ranked 3rd            -> top1 miss, top2 miss
+        #   ex3: true 3, ranked 1st            -> top1 hit, top2 hit
+        probs = np.array([
+            [0.70, 0.10, 0.10, 0.10],
+            [0.50, 0.05, 0.40, 0.05],
+            [0.50, 0.15, 0.30, 0.05],
+            [0.10, 0.20, 0.10, 0.60],
+        ])
+        labels = np.eye(4)[[0, 2, 1, 3]]
+        ev = Evaluation(top_n=2)
+        ev.eval(labels, probs)
+        assert ev.accuracy() == 0.5           # 2/4 top-1
+        assert ev.top_n_accuracy() == 0.75    # 3/4 top-2
+        assert f"Top-2" in ev.stats()
+
+    def test_top_n_merge(self):
+        probs = np.array([[0.6, 0.3, 0.1], [0.2, 0.3, 0.5]])
+        labels = np.eye(3)[[1, 1]]            # ranked 2nd both times
+        a = Evaluation(top_n=2).eval(labels[:1], probs[:1])
+        b = Evaluation(top_n=2).eval(labels[1:], probs[1:])
+        a.merge(b)
+        assert a.accuracy() == 0.0
+        assert a.top_n_accuracy() == 1.0
+
+    def test_top_n_default_is_accuracy(self):
+        probs = np.array([[0.6, 0.4], [0.3, 0.7]])
+        labels = np.eye(2)[[0, 0]]
+        ev = Evaluation().eval(labels, probs)
+        assert ev.top_n_accuracy() == ev.accuracy() == 0.5
+
+
+class TestCurveSerde:
+    def _roc(self):
+        rs = np.random.RandomState(0)
+        scores = rs.rand(200)
+        targets = (scores + rs.randn(200) * 0.3 > 0.5).astype(float)
+        return ROC().eval(targets, scores)
+
+    def test_roc_curve_roundtrip(self):
+        roc = self._roc()
+        curve = roc.get_roc_curve()
+        back = RocCurve.from_json(curve.to_json())
+        assert back == curve
+        assert back.calculate_auc() == roc.calculate_auc()
+
+    def test_pr_curve_roundtrip(self):
+        roc = self._roc()
+        curve = roc.get_precision_recall_curve()
+        back = PrecisionRecallCurve.from_json(curve.to_json())
+        assert back == curve
+        assert abs(back.calculate_auprc() - roc.calculate_auprc()) < 1e-12
+
+    def test_histogram_roundtrip(self):
+        h = Histogram(title="w", min=-1.0, max=1.0, counts=[1, 5, 9, 2])
+        assert Histogram.from_json(h.to_json()) == h
+
+    def test_histogram_wraps_stats_pipeline_entry(self):
+        # same schema StatsListener._histograms emits
+        entry = {"counts": [2, 3], "min": -0.5, "max": 0.5}
+        h = Histogram.from_stats("0/W", entry)
+        assert h.counts == [2, 3] and h.min == -0.5 and h.max == 0.5
+        assert Histogram.from_json(h.to_json()) == h
+
+    def test_roc_curve_json_is_strict(self):
+        """The +inf sentinel threshold must not leak as bare `Infinity`
+        (invalid RFC 8259 — browser JSON.parse would reject the curve)."""
+        import json as _json
+        roc = ROC().eval(np.array([1.0, 0.0, 1.0]),
+                         np.array([0.9, 0.2, 0.7]))
+        s = roc.get_roc_curve().to_json()
+        assert "Infinity" not in s
+        _json.loads(s)  # strict-parseable
+        back = RocCurve.from_json(s)
+        assert back.thresholds[0] == float("inf")
+
+    def test_wrong_class_rejected(self):
+        h = Histogram()
+        try:
+            RocCurve.from_json(h.to_json())
+        except ValueError:
+            return
+        raise AssertionError("expected ValueError")

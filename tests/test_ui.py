@@ -145,3 +145,44 @@ class TestUIServer:
             assert "Training overview" in page
         finally:
             server.stop()
+
+    def test_model_system_histogram_pages_from_live_run(self):
+        """The TrainModule model/system/histogram tabs render from a live
+        training run (reference: deeplearning4j-play TrainModule routes)."""
+        storage = InMemoryStatsStorage()
+        conf = (NeuralNetConfiguration.builder()
+                .seed(1).updater(Adam(learning_rate=0.01))
+                .list(DenseLayer(n_out=4, activation="relu"),
+                      OutputLayer(n_out=2, activation="softmax",
+                                  loss="mcxent"))
+                .set_input_type(InputType.feed_forward(3))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.set_listeners(StatsListener(storage, session_id="pages",
+                                        reporting_frequency=1,
+                                        collect_histograms=True))
+        rs = np.random.RandomState(1)
+        ds = DataSet(rs.randn(8, 3).astype(np.float32),
+                     np.eye(2, dtype=np.float32)[rs.randint(0, 2, 8)])
+        for _ in range(4):
+            net.fit(ds)
+        server = UIServer(port=0)
+        server.attach(storage)
+        port = server.start()
+        try:
+            base = f"http://127.0.0.1:{port}"
+            for path, marker in (("/model", "Model"),
+                                 ("/system", "System"),
+                                 ("/histograms", "Parameter histograms")):
+                page = urllib.request.urlopen(base + path).read().decode()
+                assert marker in page
+            sysinfo = json.loads(urllib.request.urlopen(
+                base + "/train/system?sid=pages").read())
+            assert len(sysinfo["iterations"]) == 4
+            assert all(m > 0 for m in sysinfo["memory_mb"])
+            hist = json.loads(urllib.request.urlopen(
+                base + "/train/histograms?sid=pages").read())
+            assert hist["iteration"] is not None
+            assert sum(hist["param_histograms"]["0/W"]["counts"]) == 12
+        finally:
+            server.stop()
