@@ -170,30 +170,63 @@ def bench_attention(B: int = 4, H: int = 8, T: int = 4096, d: int = 128,
     )
     from deeplearning4j_tpu.ops.pallas_attention import flash_attention
 
+    stock = jax.jit(lambda q, k, v: scaled_dot_attention(q, k, v,
+                                                         causal=True))
+    flash = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+    return (_attn_chained_ms(stock, B, H, T, d, steps, "attention"),
+            _attn_chained_ms(flash, B, H, T, d, steps, "attention"))
+
+
+def _attn_chained_ms(g, B, H, T, d, steps, label):
+    """Shared chained-serial attention timer: each call consumes the
+    previous output (q := g(q, k, v)) so queue pipelining cannot hide
+    per-call latency; refuses windows below timer resolution."""
+    import jax.numpy as jnp
+
     rs = np.random.RandomState(7)
     q0 = jnp.asarray(rs.randn(B, H, T, d), jnp.float32)
     k = jnp.asarray(rs.randn(B, H, T, d), jnp.float32)
     v = jnp.asarray(rs.randn(B, H, T, d), jnp.float32)
+    _sync(g(q0, k, v))  # compile + warm
+    t0 = time.perf_counter()
+    o = q0
+    for _ in range(steps):
+        o = g(o, k, v)
+    _sync(o)
+    total = time.perf_counter() - t0
+    if total < MIN_MARGINAL_WINDOW_S:
+        raise RuntimeError(
+            f"{label} timing window {total * 1e3:.3f} ms is below the "
+            f"{MIN_MARGINAL_WINDOW_S * 1e3:.0f} ms resolution floor — "
+            "harness bug; refusing to report")
+    return total / steps * 1000
 
-    def chained_ms(f):
-        _sync(f(q0, k, v))  # compile + warm
-        t0 = time.perf_counter()
-        o = q0
-        for _ in range(steps):
-            o = f(o, k, v)
-        _sync(o)
-        total = time.perf_counter() - t0
-        if total < MIN_MARGINAL_WINDOW_S:
-            raise RuntimeError(
-                f"attention timing window {total * 1e3:.3f} ms is below the "
-                f"{MIN_MARGINAL_WINDOW_S * 1e3:.0f} ms resolution floor — "
-                "harness bug; refusing to report")
-        return total / steps * 1000
 
-    stock = jax.jit(lambda q, k, v: scaled_dot_attention(q, k, v,
+def bench_attention_bwd(B: int = 4, H: int = 8, T: int = 2048, d: int = 128,
+                        steps: int = 20):
+    """Fwd+bwd (training) leg of the attention bench. T=2048, not 4096:
+    the stock path materialises the [B,H,T,T] score matrix in the backward
+    — at T=4096 that is ~2 GB of activations and the stock grad does not
+    fit; the flash backward (recompute-by-block Pallas kernels) is the
+    only one that runs there, which is the point of having it. Returns
+    (stock_ms, flash_ms) at the common T where both fit."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.conf.layers.attention import (
+        scaled_dot_attention,
+    )
+    from deeplearning4j_tpu.ops.pallas_attention import flash_attention
+
+    def grad_of(f):
+        return jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(f(q, k, v) ** 2), argnums=0))
+
+    stock = grad_of(lambda q, k, v: scaled_dot_attention(q, k, v,
                                                          causal=True))
-    flash = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
-    return chained_ms(stock), chained_ms(flash)
+    flash = grad_of(lambda q, k, v: flash_attention(q, k, v, causal=True))
+    return (_attn_chained_ms(stock, B, H, T, d, steps, "attention bwd"),
+            _attn_chained_ms(flash, B, H, T, d, steps, "attention bwd"))
 
 
 def bench_word2vec(n_sentences: int = 50000, epochs: int = 1):
@@ -309,6 +342,12 @@ def main():
         print(f"# attention T=4096 stock {stock_ms:.2f} ms, flash "
               f"{flash_ms:.2f} ms ({stock_ms / flash_ms:.2f}x)",
               file=sys.stderr)
+        bs, bf = bench_attention_bwd()
+        extras["attention_bwd_t2048_stock_ms"] = round(bs, 3)
+        extras["attention_bwd_t2048_flash_ms"] = round(bf, 3)
+        extras["attention_bwd_flash_speedup"] = round(bs / bf, 3)
+        print(f"# attention fwd+bwd T=2048 stock {bs:.2f} ms, flash "
+              f"{bf:.2f} ms ({bs / bf:.2f}x)", file=sys.stderr)
     if which in ("all", "resnet50"):
         extras["resnet50_bf16_img_s"] = round(
             _sane("resnet50_bf16_img_s",

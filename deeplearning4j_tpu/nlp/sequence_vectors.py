@@ -257,7 +257,10 @@ class SequenceVectors:
         return self.vocab is not None and self.vocab.contains_word(word)
 
     def _norm_syn0(self) -> np.ndarray:
-        s = np.asarray(self.syn0)
+        # slice off any mesh-padding rows (nlp/distributed.py pads tables
+        # to a multiple of the model-axis size) so zero pad rows can never
+        # rank in nearest-neighbour queries
+        s = np.asarray(self.syn0)[:self.vocab.num_words()]
         n = np.linalg.norm(s, axis=1, keepdims=True)
         return s / np.maximum(n, 1e-12)
 

@@ -14,7 +14,9 @@ def write_word2vec_binary(model, path: str) -> None:
     'word '<D float32 little-endian>'\\n' (reference:
     WordVectorSerializer.writeWordVectors binary path)."""
     syn0 = np.asarray(model.syn0, np.float32)
-    V, D = syn0.shape
+    # vocab size, NOT syn0.shape[0]: sharded tables carry mesh-padding rows
+    # past the vocabulary (nlp/distributed.py)
+    V, D = model.vocab.num_words(), syn0.shape[1]
     with open(path, "wb") as f:
         f.write(f"{V} {D}\n".encode())
         for i in range(V):
@@ -51,7 +53,8 @@ def write_word_vectors_text(model, path: str) -> None:
     WordVectorSerializer.writeWordVectors)."""
     syn0 = np.asarray(model.syn0)
     with open(path, "w", encoding="utf-8") as f:
-        for i in range(syn0.shape[0]):
+        # vocab size, not syn0.shape[0] (mesh-padding rows — see binary path)
+        for i in range(model.vocab.num_words()):
             vec = " ".join(f"{x:.6f}" for x in syn0[i])
             f.write(f"{model.vocab.word_at_index(i)} {vec}\n")
 

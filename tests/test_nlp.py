@@ -366,6 +366,85 @@ class TestSegmentUpdates:
             assert np.allclose(np.asarray(a), np.asarray(b), atol=2e-4)
 
 
+class TestDistributedEmbeddings:
+    """Vocab-row sharding over the mesh (the dl4j-spark-nlp Word2Vec
+    equivalent — see nlp/distributed.py): the SAME epoch program runs
+    GSPMD-partitioned, so sharded training must match single-device
+    training, and queries must ignore mesh-padding rows."""
+
+    def _corpus(self, n=300):
+        rs = np.random.RandomState(6)
+        day = ["day", "sun", "light", "bright", "warm"]
+        night = ["night", "moon", "dark", "star", "cold"]
+        out = []
+        for _ in range(n):
+            topic = day if rs.rand() < 0.5 else night
+            out.append(" ".join(topic[rs.randint(5)] for _ in range(10)))
+        return out
+
+    def test_sharded_matches_single_device(self):
+        from deeplearning4j_tpu.nlp.distributed import shard_embedding_tables
+        from deeplearning4j_tpu.parallel.mesh import data_model_mesh
+
+        sents = self._corpus()
+
+        def train(sharded):
+            w2v = Word2Vec(layer_size=16, window=3, min_word_frequency=2,
+                           negative=5, use_hierarchic_softmax=False,
+                           epochs=2, learning_rate=0.05, seed=11)
+            w2v.build_vocab(sents)
+            w2v.reset_weights()
+            if sharded:
+                mesh = data_model_mesh(1, 8)
+                shard_embedding_tables(w2v, mesh)
+            w2v.fit(CollectionSentenceIterator(sents))
+            return w2v
+
+        a = train(False)
+        b = train(True)
+        V = a.vocab.num_words()
+        # padded rows beyond V; vocab rows must match the unsharded run
+        assert np.asarray(b.syn0).shape[0] >= V
+        assert np.allclose(np.asarray(a.syn0),
+                           np.asarray(b.syn0)[:V], atol=1e-4)
+        # query APIs unaffected by padding rows
+        near = [w for w, _ in b.words_nearest("sun", 3)]
+        assert near == [w for w, _ in a.words_nearest("sun", 3)]
+
+    def test_sharded_model_serde_ignores_padding_rows(self):
+        import tempfile, os
+        from deeplearning4j_tpu.nlp.distributed import shard_embedding_tables
+        from deeplearning4j_tpu.nlp.serde import (read_word2vec_binary,
+                                                  write_word2vec_binary)
+        from deeplearning4j_tpu.parallel.mesh import data_model_mesh
+
+        sents = self._corpus(80)
+        w2v = Word2Vec(layer_size=8, window=2, min_word_frequency=2,
+                       negative=3, use_hierarchic_softmax=False, epochs=1,
+                       seed=2)
+        w2v.build_vocab(sents)
+        w2v.reset_weights()
+        shard_embedding_tables(w2v, data_model_mesh(1, 8))
+        w2v.fit(CollectionSentenceIterator(sents))
+        V = w2v.vocab.num_words()
+        assert np.asarray(w2v.syn0).shape[0] > V  # padding present
+        p = os.path.join(tempfile.mkdtemp(), "v.bin")
+        write_word2vec_binary(w2v, p)
+        words, vecs = read_word2vec_binary(p)
+        assert len(words) == V and "None" not in words
+        i = w2v.vocab.index_of("sun")
+        assert np.allclose(vecs[words.index("sun")],
+                           np.asarray(w2v.syn0)[i], atol=1e-6)
+
+    def test_sharded_vocab_rows_padding(self):
+        from deeplearning4j_tpu.nlp.distributed import sharded_vocab_rows
+        from deeplearning4j_tpu.parallel.mesh import data_model_mesh
+        mesh = data_model_mesh(1, 8)
+        assert sharded_vocab_rows(16, mesh) == 16
+        assert sharded_vocab_rows(17, mesh) == 24
+        assert sharded_vocab_rows(1, mesh) == 8
+
+
 class TestParagraphVectors:
     def _docs(self, n=120, seed=2):
         rs = np.random.RandomState(seed)
