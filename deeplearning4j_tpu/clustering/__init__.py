@@ -1,8 +1,9 @@
 """Clustering + spatial search (reference: deeplearning4j-core clustering/ —
-kmeans/, kdtree/, vptree/VPTree.java:39)."""
+kmeans/, kdtree/, vptree/VPTree.java:39, sptree/SpTree.java, quadtree/)."""
 
 from deeplearning4j_tpu.clustering.kmeans import KMeansClustering
 from deeplearning4j_tpu.clustering.kdtree import KDTree
+from deeplearning4j_tpu.clustering.sptree import QuadTree, SPTree
 from deeplearning4j_tpu.clustering.vptree import VPTree
 
-__all__ = ["KMeansClustering", "KDTree", "VPTree"]
+__all__ = ["KMeansClustering", "KDTree", "VPTree", "SPTree", "QuadTree"]

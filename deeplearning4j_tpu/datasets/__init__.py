@@ -11,3 +11,8 @@ from deeplearning4j_tpu.datasets.iterators import (
     AsyncDataSetIterator,
     MultipleEpochsIterator,
 )
+from deeplearning4j_tpu.datasets.streaming import (
+    ExampleCollator,
+    QueueDataSetIterator,
+    StreamingDataSetIterator,
+)

@@ -1,0 +1,137 @@
+"""Streaming ingestion: train from unbounded push-style sources.
+
+Reference: deeplearning4j-scaleout/deeplearning4j-scaleout-parallelwrapper
++ dl4j-streaming (Kafka/Camel routes feeding DataVec records into
+DataSet iterators). TPU redesign: the broker client is out of scope (zero
+egress on pods); what the framework owns is the BOUNDARY — a thread-safe
+push queue a consumer thread feeds (the Kafka-poller analog) and a pull
+iterator the training loop drains, with bounded-buffer backpressure so a
+fast producer cannot overrun device memory, plus per-example->minibatch
+collation (the DataVec record->DataSet step).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import DataSetIterator
+
+_DONE = object()
+
+
+class QueueDataSetIterator(DataSetIterator):
+    """Push side for producers, iterator side for training.
+
+    A producer thread (e.g. a message-broker consumer) calls ``put(ds)``
+    for each arriving minibatch and ``end()`` when the stream closes; the
+    training loop iterates. ``put`` blocks once ``maxsize`` batches are
+    buffered (backpressure). The iterator is single-pass: ``reset`` is a
+    no-op by design — a stream has no beginning to return to (callers that
+    need epochs buffer to a list first)."""
+
+    def __init__(self, maxsize: int = 16):
+        self._q: queue.Queue = queue.Queue(maxsize=maxsize)
+        self._ended = threading.Event()
+
+    # ------------------------------------------------------------- producer
+    def put(self, ds: DataSet, timeout: Optional[float] = None) -> None:
+        if self._ended.is_set():
+            raise RuntimeError("stream already ended")
+        self._q.put(ds, timeout=timeout)
+
+    def end(self) -> None:
+        """Close the stream. Never blocks: the flag is authoritative (the
+        consumer polls it), the sentinel is only a wake-up for a consumer
+        currently parked in get() — skipped if the buffer is full, in
+        which case the consumer is not parked."""
+        self._ended.set()
+        try:
+            self._q.put_nowait(_DONE)
+        except queue.Full:
+            pass
+
+    # ------------------------------------------------------------- consumer
+    def _iterate(self):
+        while True:
+            try:
+                item = self._q.get(timeout=0.1)
+            except queue.Empty:
+                if self._ended.is_set():
+                    return  # drained after end(): later passes end too
+                continue
+            if item is _DONE:
+                return
+            yield item
+
+    def reset(self):  # single-pass stream
+        pass
+
+
+class StreamingDataSetIterator(DataSetIterator):
+    """Pull from a (possibly slow/unbounded) source with a bound on total
+    batches per pass. ``source`` may be any iterable/generator of DataSets
+    — a socket reader, a file tailer, a generator polling an external
+    queue. ``max_batches`` bounds one training pass over an endless
+    stream (reference: Spark streaming's per-interval micro-batching)."""
+
+    def __init__(self, source: Iterable, max_batches: Optional[int] = None):
+        self.source = source
+        self.max_batches = max_batches
+        self._it = None
+
+    def _iterate(self):
+        if self._it is None:
+            self._it = iter(self.source)
+        n = 0
+        for ds in self._it:
+            yield ds
+            n += 1
+            if self.max_batches is not None and n >= self.max_batches:
+                return
+
+    def reset(self):
+        # continue the stream; a fresh pass picks up where the last ended
+        # (resetting a stream to its start is meaningless)
+        pass
+
+
+class ExampleCollator:
+    """Collate single examples into fixed-size minibatches (the DataVec
+    record -> DataSet step of the reference's streaming route). Push
+    ``add(features, label)`` per arriving record; completed batches come
+    out of ``batches()`` / flow into an attached QueueDataSetIterator."""
+
+    def __init__(self, batch_size: int, sink: Optional[QueueDataSetIterator] = None):
+        self.batch_size = batch_size
+        self.sink = sink
+        self._f: list = []
+        self._l: list = []
+        self._lock = threading.Lock()
+
+    def add(self, features, label) -> Optional[DataSet]:
+        with self._lock:
+            self._f.append(np.asarray(features))
+            self._l.append(np.asarray(label))
+            if len(self._f) < self.batch_size:
+                return None
+            ds = DataSet(np.stack(self._f), np.stack(self._l))
+            self._f, self._l = [], []
+        if self.sink is not None:
+            self.sink.put(ds)
+        return ds
+
+    def flush(self) -> Optional[DataSet]:
+        """Emit the trailing partial batch, if any."""
+        with self._lock:
+            if not self._f:
+                return None
+            ds = DataSet(np.stack(self._f), np.stack(self._l))
+            self._f, self._l = [], []
+        if self.sink is not None:
+            self.sink.put(ds)
+        return ds

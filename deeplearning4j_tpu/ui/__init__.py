@@ -25,9 +25,25 @@ from deeplearning4j_tpu.ui.storage import (
 )
 from deeplearning4j_tpu.ui.remote import RemoteUIStatsStorageRouter
 from deeplearning4j_tpu.ui.server import UIServer
+from deeplearning4j_tpu.ui.components import (
+    ChartHistogram,
+    ChartHorizontalBar,
+    ChartLine,
+    ChartScatter,
+    ChartStackedArea,
+    Component,
+    ComponentDiv,
+    ComponentTable,
+    ComponentText,
+    render_html,
+    render_html_file,
+)
 
 __all__ = [
     "StatsListener", "StatsReport", "StatsStorage", "StatsStorageRouter",
     "InMemoryStatsStorage", "FileStatsStorage", "RemoteUIStatsStorageRouter",
     "UIServer",
+    "Component", "ComponentDiv", "ComponentTable", "ComponentText",
+    "ChartLine", "ChartScatter", "ChartHistogram", "ChartHorizontalBar",
+    "ChartStackedArea", "render_html", "render_html_file",
 ]

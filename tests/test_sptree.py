@@ -1,0 +1,73 @@
+"""SPTree / QuadTree tests (ports the intent of SPTreeTest / QuadTreeTest
+in deeplearning4j-core: construction correctness, counts, BH force
+approximation vs exact)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering.sptree import QuadTree, SPTree
+
+
+def _exact_forces(y, i):
+    """Exact t-SNE repulsion terms for point i (the theta=0 ground truth)."""
+    diff = y[i] - y
+    d2 = (diff ** 2).sum(axis=1)
+    q = 1.0 / (1.0 + d2)
+    q[i] = 0.0
+    neg = (q[:, None] ** 2 * diff).sum(axis=0)
+    return neg, q.sum()
+
+
+class TestConstruction:
+    @pytest.mark.parametrize("d", [2, 3, 4])
+    def test_all_points_counted_and_contained(self, d):
+        rs = np.random.RandomState(0)
+        x = rs.randn(200, d)
+        t = SPTree(x)
+        assert t.cum_size == 200
+        assert t.is_correct()
+        assert t.depth() >= 2
+
+    def test_duplicates_terminate(self):
+        x = np.vstack([np.ones((50, 2)), np.zeros((3, 2))])
+        t = SPTree(x)
+        assert t.cum_size == 53  # stacked duplicates still counted
+
+    def test_quadtree_requires_2d(self):
+        with pytest.raises(ValueError):
+            QuadTree(np.zeros((5, 3)))
+        assert QuadTree(np.random.RandomState(1).randn(20, 2)).cum_size == 20
+
+
+class TestForces:
+    def test_theta_zero_matches_exact(self):
+        rs = np.random.RandomState(2)
+        y = rs.randn(120, 2)
+        t = QuadTree(y)
+        for i in (0, 17, 119):
+            neg, sq = t.compute_non_edge_forces(i, theta=0.0)
+            neg_e, sq_e = _exact_forces(y, i)
+            assert np.allclose(neg, neg_e, atol=1e-9)
+            assert sq == pytest.approx(sq_e, abs=1e-9)
+
+    @pytest.mark.parametrize("d", [2, 3])
+    def test_bh_approximates_exact(self, d):
+        rs = np.random.RandomState(3)
+        y = rs.randn(400, d) * 3
+        t = SPTree(y)
+        rel_errs = []
+        for i in range(0, 400, 37):
+            neg, sq = t.compute_non_edge_forces(i, theta=0.5)
+            neg_e, sq_e = _exact_forces(y, i)
+            rel_errs.append(abs(sq - sq_e) / sq_e)
+        assert np.mean(rel_errs) < 0.03  # BH-quality approximation
+
+    def test_duplicate_leaf_excludes_self_only(self):
+        y = np.vstack([np.zeros((4, 2)), np.array([[3.0, 3.0]])])
+        t = QuadTree(y)
+        far_q = 1.0 / (1.0 + 18.0)
+        # EVERY coincident point must exclude exactly itself — not just
+        # the one whose index the stacked leaf happens to store
+        for i in range(4):
+            neg, sq = t.compute_non_edge_forces(i, theta=0.0)
+            assert sq == pytest.approx(3.0 + far_q, abs=1e-9), i

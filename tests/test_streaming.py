@@ -1,0 +1,150 @@
+"""Streaming ingestion: producer-thread push training, backpressure,
+collation (ports the intent of dl4j-streaming's Kafka route tests,
+clusterlessly — the boundary is tested, the broker client is out of
+scope)."""
+
+import queue
+import threading
+import time
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.streaming import (
+    ExampleCollator,
+    QueueDataSetIterator,
+    StreamingDataSetIterator,
+)
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Adam
+
+
+def _net():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).updater(Adam(learning_rate=0.01))
+            .list(DenseLayer(n_out=8, activation="relu"),
+                  OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _batch(rs, n=16):
+    labels = rs.randint(0, 2, n)
+    return DataSet((rs.randn(n, 4) + labels[:, None]).astype(np.float32),
+                   np.eye(2, dtype=np.float32)[labels])
+
+
+class TestQueueIterator:
+    def test_train_from_producer_thread(self):
+        it = QueueDataSetIterator(maxsize=4)
+        rs = np.random.RandomState(0)
+
+        def produce():
+            for _ in range(12):
+                it.put(_batch(rs))
+                time.sleep(0.002)  # trickle like a real stream
+            it.end()
+
+        t = threading.Thread(target=produce)
+        t.start()
+        net = _net()
+        net.fit(it)          # drains the stream as one pass
+        t.join()
+        assert net.iteration == 12
+
+    def test_backpressure_blocks_producer(self):
+        it = QueueDataSetIterator(maxsize=2)
+        rs = np.random.RandomState(1)
+        it.put(_batch(rs))
+        it.put(_batch(rs))
+        try:
+            it.put(_batch(rs), timeout=0.1)
+        except queue.Full:
+            return
+        raise AssertionError("expected queue.Full under backpressure")
+
+    def test_put_after_end_rejected(self):
+        it = QueueDataSetIterator()
+        it.end()
+        rs = np.random.RandomState(2)
+        try:
+            it.put(_batch(rs))
+        except RuntimeError:
+            return
+        raise AssertionError("expected RuntimeError")
+
+    def test_second_pass_after_end_terminates(self):
+        it = QueueDataSetIterator()
+        rs = np.random.RandomState(5)
+        it.put(_batch(rs))
+        it.end()
+        assert len(list(it)) == 1
+        assert list(it) == []  # drained stream: ends, does not deadlock
+
+    def test_end_with_full_buffer_does_not_block(self):
+        it = QueueDataSetIterator(maxsize=1)
+        rs = np.random.RandomState(6)
+        it.put(_batch(rs))
+        t0 = time.time()
+        it.end()               # buffer full: must return immediately
+        assert time.time() - t0 < 1.0
+        assert len(list(it)) == 1
+
+
+class TestStreamingIterator:
+    def test_bounded_pass_over_endless_source(self):
+        rs = np.random.RandomState(3)
+
+        def endless():
+            while True:
+                yield _batch(rs)
+
+        it = StreamingDataSetIterator(endless(), max_batches=5)
+        net = _net()
+        net.fit(it)
+        assert net.iteration == 5
+        # a second pass continues the same stream (no reset-to-start)
+        net.fit(it)
+        assert net.iteration == 10
+
+
+class TestCollator:
+    def test_collates_records_into_batches(self):
+        sink = QueueDataSetIterator()
+        col = ExampleCollator(batch_size=4, sink=sink)
+        rs = np.random.RandomState(4)
+        for i in range(10):
+            col.add(rs.randn(3).astype(np.float32),
+                    np.eye(2, dtype=np.float32)[i % 2])
+        col.flush()
+        sink.end()
+        sizes = [ds.features.shape[0] for ds in sink]
+        assert sizes == [4, 4, 2]
+
+    def test_thread_safe_collation(self):
+        col = ExampleCollator(batch_size=8)
+        out = []
+        rs_lock = threading.Lock()
+
+        def worker(seed):
+            rs = np.random.RandomState(seed)
+            for _ in range(40):
+                ds = col.add(rs.randn(3).astype(np.float32),
+                             np.eye(2, dtype=np.float32)[0])
+                if ds is not None:
+                    with rs_lock:
+                        out.append(ds)
+
+        ts = [threading.Thread(target=worker, args=(s,)) for s in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        tail = col.flush()
+        total = sum(d.features.shape[0] for d in out) + \
+            (tail.features.shape[0] if tail is not None else 0)
+        assert total == 160
+        assert all(d.features.shape[0] == 8 for d in out)

@@ -1,0 +1,110 @@
+"""Declarative UI components: serde round-trips and standalone page
+rendering (ports the intent of TestComponentSerialization and
+TestStandAlone from deeplearning4j-ui-components)."""
+
+import json
+
+import numpy as np
+
+from deeplearning4j_tpu.ui.components import (
+    ChartHistogram,
+    ChartHorizontalBar,
+    ChartLine,
+    ChartScatter,
+    ChartStackedArea,
+    Component,
+    ComponentDiv,
+    ComponentTable,
+    ComponentText,
+    StyleChart,
+    StyleText,
+    render_html,
+    render_html_file,
+)
+
+
+def _all_components():
+    line = ChartLine(title="loss").add_series("train", [0, 1, 2],
+                                              [1.0, 0.5, 0.3])
+    line.add_series("val", [0, 1, 2], [1.2, 0.7, 0.5])
+    scatter = ChartScatter(title="emb").add_series("pts", [0.1, 0.5],
+                                                   [0.2, 0.9])
+    hist = (ChartHistogram(title="weights")
+            .add_bin(-1, 0, 5).add_bin(0, 1, 12))
+    bars = ChartHorizontalBar(title="acc", labels=["a", "b"],
+                              values=[0.9, 0.7])
+    area = ChartStackedArea(title="mem", x=[0, 1, 2],
+                            y=[[1, 2, 3], [2, 2, 2]], labels=["heap", "dev"])
+    table = ComponentTable(header=["k", "v"],
+                           content=[["lr", "0.01"], ["bs", "128"]])
+    text = ComponentText(text="training report",
+                         style=StyleText(font_size=18))
+    return [line, scatter, hist, bars, area, table, text]
+
+
+class TestComponentSerde:
+    def test_round_trip_all_types(self):
+        for c in _all_components():
+            back = Component.from_json(c.to_json())
+            assert type(back) is type(c)
+            assert back == c, type(c).__name__
+
+    def test_component_type_tag(self):
+        d = json.loads(ChartLine(title="t").to_json())
+        assert d["componentType"] == "ChartLine"
+
+    def test_unknown_type_rejected(self):
+        try:
+            Component.from_json('{"componentType": "Nope"}')
+        except ValueError:
+            return
+        raise AssertionError("expected ValueError")
+
+    def test_div_nests_children(self):
+        div = ComponentDiv().add(ComponentText(text="a"),
+                                 ChartLine(title="b"))
+        back = Component.from_json(div.to_json())
+        assert len(back.children) == 2
+        assert back.children[0]["componentType"] == "ComponentText"
+        # children round-trip individually
+        child = Component.from_dict(back.children[1])
+        assert isinstance(child, ChartLine) and child.title == "b"
+
+    def test_style_round_trip(self):
+        c = ChartLine(style=StyleChart(width=200, stroke_width=4.0))
+        back = Component.from_json(c.to_json())
+        assert back.style.width == 200 and back.style.stroke_width == 4.0
+
+
+class TestRenderHtml:
+    def test_standalone_page_embeds_data_and_renderer(self):
+        page = render_html(_all_components(), title="report 1")
+        assert "<title>report 1</title>" in page
+        assert "renderComponent" in page
+        assert "ChartStackedArea" in page and "ComponentTable" in page
+        # data embedded verbatim (training report text + a series value)
+        assert "training report" in page
+        # page is self-contained: no external scripts or stylesheets
+        assert "http" not in page.split("</title>")[1]
+
+    def test_script_breakout_escaped(self):
+        page = render_html([ComponentText(text="x</script><b>oops")],
+                           title="<t>&1")
+        assert "</script><b>oops" not in page
+        assert "<\\/script>" in page       # inert to the HTML parser
+        assert "<title>&lt;t&gt;&amp;1</title>" in page
+
+    def test_render_file(self, tmp_path):
+        p = tmp_path / "report.html"
+        render_html_file(_all_components(), str(p))
+        assert p.read_text().startswith("<!doctype html>")
+
+    def test_from_stats_histogram_renders(self):
+        # end-to-end with the stats pipeline schema
+        counts, edges = np.histogram(np.random.RandomState(0).randn(500),
+                                     bins=10)
+        h = ChartHistogram(title="0/W")
+        for i, c in enumerate(counts):
+            h.add_bin(edges[i], edges[i + 1], float(c))
+        page = render_html([h])
+        assert "0/W" in page and str(int(counts.max())) in page
