@@ -19,13 +19,58 @@ batches.
 
 from __future__ import annotations
 
+import warnings
 from typing import Optional
 
+import numpy as np
 from jax.sharding import Mesh
 
 from deeplearning4j_tpu.parallel.evaluation import evaluate_on_mesh
 from deeplearning4j_tpu.parallel.mesh import data_mesh
 from deeplearning4j_tpu.parallel.trainer import AVERAGING, ParallelWrapper
+
+# Repartition strategies (reference: spark/api/Repartition.java — Always /
+# Never / NumPartitionsWorkersDiffers; RepartitionStrategy.Balanced)
+REPARTITION_ALWAYS = "always"
+REPARTITION_NEVER = "never"
+
+
+def repartition_datasets(data, batch_size: int,
+                         strategy: str = REPARTITION_ALWAYS):
+    """Balance-if-required (reference:
+    SparkUtils.repartitionBalanceIfRequired, the ParameterAveraging
+    default path): if the incoming DataSets are already uniform
+    minibatches, keep them; otherwise re-split ALL examples into uniform
+    ``batch_size`` minibatches. The observable semantics are the ones that
+    matter for mesh training: every worker round sees same-shaped batches,
+    so XLA compiles ONE program shape and no mid-stream odd batch is
+    dropped."""
+    if strategy == REPARTITION_NEVER:
+        return list(data)
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    data = list(data)
+    if not data:
+        return data
+    sizes = {int(np.shape(d.features)[0]) for d in data}
+    if len(sizes) == 1:
+        return data  # already balanced
+    if any(d.features_mask is not None or d.labels_mask is not None
+           for d in data):
+        # masked (variable-length) data: element moves would need mask
+        # re-padding; keep caller batching
+        return data
+    feats = np.concatenate([np.asarray(d.features) for d in data])
+    labels = np.concatenate([np.asarray(d.labels) for d in data])
+    n = feats.shape[0]
+    out = []
+    for s in range(0, n - n % batch_size, batch_size):
+        out.append(DataSet(feats[s:s + batch_size],
+                           labels[s:s + batch_size]))
+    tail = n % batch_size
+    if tail:
+        out.append(DataSet(feats[n - tail:], labels[n - tail:]))
+    return out
 
 
 class TrainingMaster:
@@ -36,22 +81,38 @@ class TrainingMaster:
 
 
 class ParameterAveragingTrainingMaster(TrainingMaster):
-    """reference: impl/paramavg/ParameterAveragingTrainingMaster.java —
-    builder knobs kept: batch_size_per_worker, averaging_frequency,
-    aggregation_depth (accepted; XLA picks the reduction tree on ICI so it is
-    a no-op here), repartition strategy (host-side round-robin is the only
-    one needed: device feeding is deterministic)."""
+    """reference: impl/paramavg/ParameterAveragingTrainingMaster.java.
+
+    ``repartition``: 'always' means balance-IF-REQUIRED (the reference's
+    default path) — uniform incoming minibatches are kept as the round
+    unit whatever their size; only RAGGED unmasked data is re-sliced into
+    uniform batch_size_per_worker minibatches (masked variable-length data
+    is left to caller batching — element moves would need mask
+    re-padding). 'never' always trusts caller batching.
+    ``aggregation_depth`` (Spark treeAggregate fan-in) cannot have an
+    effect: parameter averaging is one ``lax.pmean`` and XLA chooses the
+    reduction tree over ICI — passing a non-default value warns rather
+    than silently pretending."""
 
     def __init__(self, batch_size_per_worker: int = 16,
                  averaging_frequency: int = 1,
                  aggregation_depth: int = 2,
                  average_updaters: bool = True,
+                 repartition: str = REPARTITION_ALWAYS,
                  mesh: Optional[Mesh] = None,
                  workers: Optional[int] = None):
         self.batch_size_per_worker = batch_size_per_worker
         self.averaging_frequency = averaging_frequency
+        if aggregation_depth != 2:
+            warnings.warn(
+                "aggregation_depth has no effect on a device mesh: "
+                "averaging is one XLA pmean and the compiler picks the "
+                "reduction tree over ICI", stacklevel=2)
         self.aggregation_depth = aggregation_depth
         self.average_updaters = average_updaters
+        if repartition not in (REPARTITION_ALWAYS, REPARTITION_NEVER):
+            raise ValueError(f"Unknown repartition '{repartition}'")
+        self.repartition = repartition
         self.mesh = mesh if mesh is not None else data_mesh(workers)
 
     def execute_training(self, net, data) -> None:
@@ -62,6 +123,9 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
 
         if isinstance(data, DataSet):
             data = list(data.batch_by(self.batch_size_per_worker))
+        else:
+            data = repartition_datasets(data, self.batch_size_per_worker,
+                                        self.repartition)
         pw = ParallelWrapper(net, mesh=self.mesh, mode=AVERAGING,
                              averaging_frequency=self.averaging_frequency,
                              average_updaters=self.average_updaters)

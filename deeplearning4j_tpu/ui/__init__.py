@@ -29,6 +29,7 @@ from deeplearning4j_tpu.ui.components import (
     ChartHistogram,
     ChartHorizontalBar,
     ChartLine,
+    ChartMatrix,
     ChartScatter,
     ChartStackedArea,
     Component,
@@ -38,6 +39,11 @@ from deeplearning4j_tpu.ui.components import (
     render_html,
     render_html_file,
 )
+from deeplearning4j_tpu.ui.legacy import (
+    ConvolutionalIterationListener,
+    FlowIterationListener,
+    HistogramIterationListener,
+)
 
 __all__ = [
     "StatsListener", "StatsReport", "StatsStorage", "StatsStorageRouter",
@@ -45,5 +51,7 @@ __all__ = [
     "UIServer",
     "Component", "ComponentDiv", "ComponentTable", "ComponentText",
     "ChartLine", "ChartScatter", "ChartHistogram", "ChartHorizontalBar",
-    "ChartStackedArea", "render_html", "render_html_file",
+    "ChartStackedArea", "ChartMatrix", "render_html", "render_html_file",
+    "HistogramIterationListener", "FlowIterationListener",
+    "ConvolutionalIterationListener",
 ]

@@ -182,6 +182,21 @@ class ChartStackedArea(Component):
 
 @_register
 @dataclass
+class ChartMatrix(Component):
+    """Heatmap over a 2-D value grid (no direct reference analog as a
+    component — the reference's ConvolutionalIterationListener renders
+    activation grids server-side to PNG; here the grid is data and the
+    page renders it, which also serves confusion matrices)."""
+
+    title: str = ""
+    values: List[List[float]] = field(default_factory=list)
+    row_labels: List[str] = field(default_factory=list)
+    col_labels: List[str] = field(default_factory=list)
+    style: StyleChart = field(default_factory=StyleChart)
+
+
+@_register
+@dataclass
 class ComponentTable(Component):
     """Simple table (reference: ComponentTable.java)."""
 
@@ -322,6 +337,21 @@ function renderComponent(c, root){
    g.closePath();g.fill();
    g.fillStyle='#333';g.fillText(c.labels[i]||('s'+i),l+pw-80,tp+12+12*i);
    base=top;});
+ }else if(t==='ChartMatrix'){
+  const R=c.values.length;if(!R)return;
+  const C=c.values[0].length;
+  const vmin=amin(c.values.map(amin)),vmax=amax(c.values.map(amax));
+  const cw=pw/C,chh=ph/R;
+  for(let i=0;i<R;i++)for(let j=0;j<C;j++){
+   const u=(c.values[i][j]-vmin)/((vmax-vmin)||1);
+   const hue=240-240*u;  // blue (low) -> red (high)
+   g.fillStyle='hsl('+hue+',80%,'+(30+40*u)+'%)';
+   g.fillRect(l+j*cw,tp+i*chh,Math.ceil(cw),Math.ceil(chh));}
+  g.fillStyle='#333';
+  (c.row_labels||[]).forEach((s,i)=>g.fillText(s,2,tp+i*chh+chh/2));
+  (c.col_labels||[]).forEach((s,j)=>g.fillText(s,l+j*cw,H-4));
+  g.fillText(vmax.toPrecision(3)+' max',l+pw-70,tp+10);
+  g.fillText(vmin.toPrecision(3)+' min',l+pw-70,tp+22);
  }
 }
 """

@@ -1,0 +1,110 @@
+"""Legacy visual listeners (ports the intent of FlowIterationListenerTest
+and the HistogramIterationListener smoke tests from deeplearning4j-ui)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    ConvolutionLayer,
+    DenseLayer,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Adam
+from deeplearning4j_tpu.ui import (
+    ConvolutionalIterationListener,
+    FlowIterationListener,
+    HistogramIterationListener,
+)
+
+
+def _dense_net():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Adam(learning_rate=0.01))
+            .list(DenseLayer(n_out=8, activation="relu"),
+                  OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _fit_some(net, iters=6):
+    rs = np.random.RandomState(0)
+    labels = rs.randint(0, 2, 16)
+    ds = DataSet((rs.randn(16, 4) + labels[:, None]).astype(np.float32),
+                 np.eye(2, dtype=np.float32)[labels])
+    for _ in range(iters):
+        net.fit(ds)
+
+
+class TestHistogramListener:
+    def test_writes_report_with_all_params(self, tmp_path):
+        net = _dense_net()
+        net.set_listeners(HistogramIterationListener(str(tmp_path),
+                                                     frequency=3))
+        _fit_some(net)
+        page = (tmp_path / "histograms.html").read_text()
+        for name in ("0/W", "0/b", "1/W", "1/b"):
+            assert name in page
+        assert "score" in page
+
+
+class TestFlowListener:
+    def test_topology_table(self, tmp_path):
+        net = _dense_net()
+        net.set_listeners(FlowIterationListener(str(tmp_path), frequency=2))
+        _fit_some(net, iters=2)
+        page = (tmp_path / "flow.html").read_text()
+        assert "DenseLayer" in page and "OutputLayer" in page
+        assert "MultiLayerNetwork" in page
+
+
+class TestConvListener:
+    def test_feature_map_heatmaps(self, tmp_path):
+        conf = (NeuralNetConfiguration.builder()
+                .seed(2).updater(Adam(learning_rate=0.01))
+                .list(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                       activation="relu"),
+                      OutputLayer(n_out=2, activation="softmax",
+                                  loss="mcxent"))
+                .set_input_type(InputType.convolutional(8, 8, 1))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rs = np.random.RandomState(1)
+        probe = rs.randn(1, 8, 8, 1).astype(np.float32)
+        net.set_listeners(ConvolutionalIterationListener(
+            str(tmp_path), probe, frequency=1, max_maps=2))
+        ds = DataSet(rs.randn(4, 8, 8, 1).astype(np.float32),
+                     np.eye(2, dtype=np.float32)[rs.randint(0, 2, 4)])
+        net.fit(ds)
+        page = (tmp_path / "activations.html").read_text()
+        assert "ChartMatrix" in page
+        assert "layer 0 map 0" in page and "layer 0 map 1" in page
+
+    def test_works_with_computation_graph(self, tmp_path):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        conf = (NeuralNetConfiguration.builder()
+                .seed(3).updater(Adam(learning_rate=0.01))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("conv", ConvolutionLayer(n_out=3,
+                                                    kernel_size=(3, 3),
+                                                    activation="relu"),
+                           "in")
+                .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                              loss="mcxent"), "conv")
+                .set_outputs("out")
+                .set_input_types(InputType.convolutional(8, 8, 1))
+                .build())
+        net = ComputationGraph(conf).init()
+        rs = np.random.RandomState(2)
+        probe = rs.randn(1, 8, 8, 1).astype(np.float32)
+        net.set_listeners(ConvolutionalIterationListener(
+            str(tmp_path), probe, frequency=1, max_maps=1))
+        ds = DataSet(rs.randn(4, 8, 8, 1).astype(np.float32),
+                     np.eye(2, dtype=np.float32)[rs.randint(0, 2, 4)])
+        net.fit(ds)
+        page = (tmp_path / "activations.html").read_text()
+        assert "ChartMatrix" in page

@@ -48,6 +48,52 @@ def _batches(n_batches=16, batch=4, seed=0):
 
 
 class TestSparkFacade:
+    def test_repartition_balances_ragged_batches(self):
+        """repartitionBalanceIfRequired semantics: ragged input re-splits
+        into uniform minibatches; uniform input is left alone."""
+        from deeplearning4j_tpu.parallel.spark import (
+            REPARTITION_NEVER, repartition_datasets)
+
+        rs = np.random.RandomState(1)
+        ragged = [DataSet(rs.randn(n, 4).astype(np.float32),
+                          np.eye(3, dtype=np.float32)[rs.randint(0, 3, n)])
+                  for n in (7, 3, 9, 5)]
+        out = repartition_datasets(ragged, batch_size=6)
+        assert [d.features.shape[0] for d in out] == [6, 6, 6, 6]
+        # examples preserved in order
+        np.testing.assert_array_equal(
+            np.concatenate([d.features for d in out]),
+            np.concatenate([d.features for d in ragged]))
+        # uniform input untouched (identity), never-strategy untouched
+        uniform = _batches(4, 4)
+        assert repartition_datasets(uniform, 6) is not uniform  # new list
+        assert [d.features.shape[0]
+                for d in repartition_datasets(uniform, 6)] == [4, 4, 4, 4]
+        assert [d.features.shape[0]
+                for d in repartition_datasets(ragged, 6,
+                                              REPARTITION_NEVER)] == \
+            [7, 3, 9, 5]
+
+    def test_ragged_batches_train_without_drops(self):
+        """End-to-end: ragged input through the facade trains every example
+        (previously the wrapper dropped mid-stream size mismatches)."""
+        rs = np.random.RandomState(2)
+        ragged = [DataSet((rs.randn(n, 4) + 1).astype(np.float64),
+                          np.eye(3)[rs.randint(0, 3, n)])
+                  for n in (13, 7, 9, 3)]  # 32 examples
+        net = _net()
+        master = ParameterAveragingTrainingMaster(batch_size_per_worker=4,
+                                                  workers=8)
+        SparkDl4jMultiLayer(net, master).fit(ragged)
+        assert net.iteration > 0
+
+    def test_aggregation_depth_warns(self):
+        import warnings as _warnings
+        with _warnings.catch_warnings(record=True) as w:
+            _warnings.simplefilter("always")
+            ParameterAveragingTrainingMaster(aggregation_depth=4, workers=8)
+        assert any("aggregation_depth" in str(x.message) for x in w)
+
     def test_param_averaging_equals_single_machine(self):
         """The ported TestCompareParameterAveragingSparkVsSingleMachine
         contract, through the Spark-style facade: averaging_frequency=1 SGD
