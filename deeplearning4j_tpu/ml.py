@@ -1,0 +1,136 @@
+"""Estimator-protocol wrappers: the dl4j-spark-ml analog.
+
+Reference: deeplearning4j-scaleout spark/dl4j-spark-ml —
+SparkDl4jNetwork.scala wraps a network as a Spark ML Pipeline
+``Estimator``/``Model`` so it composes with that ecosystem's tooling.
+The Python ecosystem's pipeline protocol is scikit-learn's
+fit/predict/transform + get_params/set_params duck type — implemented
+here WITHOUT importing sklearn (works standalone, and drops into
+sklearn Pipelines/GridSearchCV when sklearn is present).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+try:  # real sklearn bases when available (tags/clone/check_is_fitted
+    # integration); plain-object fallback keeps this module standalone
+    from sklearn.base import BaseEstimator, ClassifierMixin, RegressorMixin
+except ImportError:  # pragma: no cover - sklearn is in the image
+    class BaseEstimator:  # type: ignore[no-redef]
+        pass
+
+    class ClassifierMixin:  # type: ignore[no-redef]
+        pass
+
+    class RegressorMixin:  # type: ignore[no-redef]
+        pass
+
+
+class DL4JEstimator(BaseEstimator):
+    """Base estimator: wraps a network-builder callable.
+
+    ``conf_factory``: () -> built configuration; the network is
+    constructed fresh on each fit (sklearn semantics: fit resets)."""
+
+    def __init__(self, conf_factory: Callable, epochs: int = 10,
+                 batch_size: int = 32):
+        self.conf_factory = conf_factory
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.net_ = None
+
+    # sklearn protocol -----------------------------------------------------
+    def get_params(self, deep: bool = True) -> dict:
+        return {"conf_factory": self.conf_factory, "epochs": self.epochs,
+                "batch_size": self.batch_size}
+
+    def set_params(self, **params) -> "DL4JEstimator":
+        valid = self.get_params()
+        for k, v in params.items():
+            if k not in valid:  # constructor params only (sklearn contract)
+                raise ValueError(f"Invalid parameter {k}")
+            setattr(self, k, v)
+        return self
+
+    def _fit_net(self, x, y):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        conf = self.conf_factory()
+        net_cls = (ComputationGraph if hasattr(conf, "vertices")
+                   else MultiLayerNetwork)
+        self.net_ = net_cls(conf).init()
+        self.net_.fit(ListDataSetIterator(DataSet(x, y),
+                                          batch_size=self.batch_size),
+                      epochs=self.epochs)
+        return self
+
+    def _check_fitted(self):
+        if self.net_ is None:
+            raise RuntimeError("Estimator is not fitted; call fit first")
+
+
+class DL4JClassifier(ClassifierMixin, DL4JEstimator):
+    """Classifier over a softmax-output network. y: class indices [N] or
+    one-hot [N, C]."""
+
+    def fit(self, x, y) -> "DL4JClassifier":
+        x = np.asarray(x)
+        y = np.asarray(y)
+        if y.ndim == 1:
+            self.classes_ = np.unique(y)
+            onehot = np.zeros((y.size, self.classes_.size), np.float32)
+            onehot[np.arange(y.size),
+                   np.searchsorted(self.classes_, y)] = 1.0
+            y = onehot
+        else:
+            self.classes_ = np.arange(y.shape[1])
+        return self._fit_net(x, y)
+
+    def predict_proba(self, x) -> np.ndarray:
+        self._check_fitted()
+        out = self.net_.output(np.asarray(x))
+        if isinstance(out, (list, tuple)):
+            out = out[0]
+        return np.asarray(out)
+
+    def predict(self, x) -> np.ndarray:
+        proba = self.predict_proba(x)  # raises if unfitted
+        return self.classes_[np.argmax(proba, axis=-1)]
+
+    def score(self, x, y) -> float:
+        """Mean accuracy (the sklearn classifier contract)."""
+        return float(np.mean(self.predict(x) == np.asarray(y)))
+
+
+class DL4JRegressor(RegressorMixin, DL4JEstimator):
+    """Regressor over an identity/linear-output network. y: [N] or [N, K]."""
+
+    def fit(self, x, y) -> "DL4JRegressor":
+        x = np.asarray(x)
+        y = np.asarray(y, np.float32)
+        self._squeeze = y.ndim == 1
+        if self._squeeze:
+            y = y[:, None]
+        return self._fit_net(x, y)
+
+    def predict(self, x) -> np.ndarray:
+        self._check_fitted()
+        out = self.net_.output(np.asarray(x))
+        if isinstance(out, (list, tuple)):
+            out = out[0]
+        out = np.asarray(out)
+        return out[:, 0] if self._squeeze and out.ndim == 2 else out
+
+    def score(self, x, y) -> float:
+        """R^2 (the sklearn regressor contract)."""
+        y = np.asarray(y, np.float64)
+        pred = np.asarray(self.predict(x), np.float64)
+        ss_res = float(np.sum((y - pred) ** 2))
+        ss_tot = float(np.sum((y - np.mean(y)) ** 2))
+        return 1.0 - ss_res / ss_tot if ss_tot else 0.0

@@ -1,0 +1,157 @@
+"""Keras-backend entry point: drive training of imported Keras models from
+an external process.
+
+Reference: deeplearning4j-keras — a py4j ``GatewayServer``
+(keras/Server.java:18) exposing ``DeepLearning4jEntryPoint.fit``
+(keras/DeepLearning4jEntryPoint.java:12,21-33): a Python Keras process
+hands over an exported model file plus minibatch files on disk, and the
+JVM trains. Here the transport is stdlib HTTP+JSON (py4j is a JVM bridge;
+an HTTP entry point serves any client), batches are ``.npz`` files with
+``features``/``labels`` arrays (the HDF5MiniBatchDataSetIterator analog):
+
+- POST /import   {"path": "model.h5"}                   -> {"model": id}
+- POST /fit      {"model": id, "batches": [paths], "epochs": n}
+- POST /evaluate {"model": id, "batches": [paths]}      -> {"accuracy": ..}
+- POST /predict  {"model": id, "features": [[..], ..]}  -> {"output": ..}
+- GET  /models                                          -> {"models": [..]}
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+
+class KerasBackendServer:
+    def __init__(self, port: int = 0):
+        self._port = port
+        self._models: dict = {}
+        self._next_id = 0
+        self._lock = threading.Lock()
+        self._httpd = None
+        self._thread = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd else self._port
+
+    # ---------------------------------------------------------- operations
+    def import_model(self, path: str) -> str:
+        from deeplearning4j_tpu.modelimport.keras import (
+            import_keras_model_and_weights,
+        )
+        net = import_keras_model_and_weights(path)
+        with self._lock:
+            mid = f"m{self._next_id}"
+            self._next_id += 1
+            self._models[mid] = net
+        return mid
+
+    def _net(self, mid: str):
+        net = self._models.get(mid)
+        if net is None:
+            raise KeyError(f"unknown model '{mid}'")
+        return net
+
+    @staticmethod
+    def _load_batches(paths):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        out = []
+        for p in paths:
+            with np.load(p) as z:
+                out.append(DataSet(z["features"], z["labels"]))
+        return out
+
+    def fit(self, mid: str, batch_paths, epochs: int = 1) -> dict:
+        # one lock serializes all model operations: ThreadingHTTPServer
+        # handles requests concurrently, and two interleaved fit() calls on
+        # one net would race on its iteration/score/updater state
+        with self._lock:
+            net = self._net(mid)
+            batches = self._load_batches(batch_paths)
+            for _ in range(epochs):
+                for ds in batches:
+                    net.fit(ds)
+            return {"iterations": int(net.iteration),
+                    "score": float(net.score_value)}
+
+    def evaluate(self, mid: str, batch_paths) -> dict:
+        from deeplearning4j_tpu.evaluation import Evaluation
+
+        with self._lock:
+            net = self._net(mid)
+            ev = Evaluation()
+            for ds in self._load_batches(batch_paths):
+                ev.eval(ds.labels, np.asarray(net.output(ds.features)))
+            return {"accuracy": ev.accuracy(), "f1": ev.f1()}
+
+    def predict(self, mid: str, features) -> list:
+        with self._lock:
+            out = self._net(mid).output(np.asarray(features, np.float32))
+        if isinstance(out, (list, tuple)):
+            out = out[0]
+        return np.asarray(out).tolist()
+
+    def list_models(self) -> list:
+        with self._lock:
+            return sorted(self._models)
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> int:
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, obj, status=200):
+                body = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/models":
+                    self._json({"models": server.list_models()})
+                else:
+                    self._json({"error": "not found"}, 404)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    req = json.loads(self.rfile.read(n))
+                    if self.path == "/import":
+                        self._json({"model":
+                                    server.import_model(req["path"])})
+                    elif self.path == "/fit":
+                        self._json(server.fit(req["model"], req["batches"],
+                                              int(req.get("epochs", 1))))
+                    elif self.path == "/evaluate":
+                        self._json(server.evaluate(req["model"],
+                                                   req["batches"]))
+                    elif self.path == "/predict":
+                        self._json({"output":
+                                    server.predict(req["model"],
+                                                   req["features"])})
+                    else:
+                        self._json({"error": "not found"}, 404)
+                except Exception as e:  # noqa: BLE001 — report to client
+                    self._json({"error": f"{type(e).__name__}: {e}"}, 400)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self._port), Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

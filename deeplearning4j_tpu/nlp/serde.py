@@ -62,10 +62,13 @@ def write_word_vectors_text(model, path: str) -> None:
 def read_word_vectors_text(path: str):
     words, rows = [], []
     with open(path, encoding="utf-8") as f:
-        for line in f:
-            parts = line.rstrip("\n").split(" ")
+        for i, line in enumerate(f):
+            parts = line.split()  # whitespace split also strips CRLF \r
             if len(parts) < 2:
                 continue
+            if i == 0 and len(parts) == 2 and all(p.isdigit()
+                                                  for p in parts):
+                continue  # optional gensim-style "V D" count header
             words.append(parts[0])
             rows.append([float(x) for x in parts[1:]])
     return words, np.asarray(rows, np.float32)

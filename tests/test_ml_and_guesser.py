@@ -1,0 +1,182 @@
+"""Estimator wrappers (spark-ml analog), ModelGuesser, and the
+Keras-backend entry-point server (py4j analog)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ml import DL4JClassifier, DL4JRegressor
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.updater import Adam
+
+
+def _clf_conf():
+    return (NeuralNetConfiguration.builder()
+            .seed(7).updater(Adam(learning_rate=0.05))
+            .list(DenseLayer(n_out=16, activation="relu"),
+                  OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+
+
+def _reg_conf():
+    return (NeuralNetConfiguration.builder()
+            .seed(7).updater(Adam(learning_rate=0.05))
+            .list(DenseLayer(n_out=16, activation="relu"),
+                  OutputLayer(n_out=1, activation="identity", loss="mse"))
+            .set_input_type(InputType.feed_forward(3)).build())
+
+
+class TestEstimators:
+    def test_classifier_fit_predict_score(self):
+        rs = np.random.RandomState(0)
+        y = rs.randint(0, 3, 256)
+        x = (rs.randn(256, 4) + 2 * y[:, None]).astype(np.float32)
+        clf = DL4JClassifier(_clf_conf, epochs=30, batch_size=64)
+        clf.fit(x, y)
+        assert clf.score(x, y) > 0.9
+        proba = clf.predict_proba(x[:5])
+        assert proba.shape == (5, 3)
+        np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+
+    def test_classifier_string_labels(self):
+        rs = np.random.RandomState(1)
+        names = np.array(["cat", "dog", "fox"])
+        yi = rs.randint(0, 3, 128)
+        x = (rs.randn(128, 4) + 2 * yi[:, None]).astype(np.float32)
+        clf = DL4JClassifier(_clf_conf, epochs=25, batch_size=64)
+        clf.fit(x, names[yi])
+        pred = clf.predict(x[:10])
+        assert set(pred) <= set(names)
+        assert clf.score(x, names[yi]) > 0.8
+
+    def test_regressor_r2(self):
+        rs = np.random.RandomState(2)
+        x = rs.randn(256, 3).astype(np.float32)
+        y = (x @ np.array([1.0, -2.0, 0.5])).astype(np.float32)
+        reg = DL4JRegressor(_reg_conf, epochs=60, batch_size=64)
+        reg.fit(x, y)
+        assert reg.score(x, y) > 0.8
+        assert reg.predict(x[:7]).shape == (7,)
+
+    def test_params_protocol_and_unfitted(self):
+        clf = DL4JClassifier(_clf_conf, epochs=3)
+        p = clf.get_params()
+        assert p["epochs"] == 3
+        clf.set_params(epochs=5)
+        assert clf.epochs == 5
+        with pytest.raises(ValueError):
+            clf.set_params(nope=1)
+        with pytest.raises(RuntimeError):
+            clf.predict(np.zeros((1, 4)))
+
+
+class TestModelGuesser:
+    def test_guesses_all_formats(self, tmp_path):
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.utils.model_guesser import (guess_format,
+                                                            load_model_guess)
+        from deeplearning4j_tpu.utils.model_serializer import save_model
+
+        # dl4j zip
+        net = MultiLayerNetwork(_clf_conf()).init()
+        zp = str(tmp_path / "net.zip")
+        save_model(net, zp)
+        assert guess_format(zp) == "dl4j-zip"
+        loaded = load_model_guess(zp)
+        np.testing.assert_allclose(loaded.params_flat(), net.params_flat())
+
+        # word2vec binary + text
+        from deeplearning4j_tpu.nlp import (CollectionSentenceIterator,
+                                            Word2Vec)
+        from deeplearning4j_tpu.nlp.serde import (write_word2vec_binary,
+                                                  write_word_vectors_text)
+        rs = np.random.RandomState(0)
+        sents = [" ".join(f"w{rs.randint(20)}" for _ in range(8))
+                 for _ in range(100)]
+        w2v = Word2Vec(layer_size=8, window=2, min_word_frequency=1,
+                       epochs=1, seed=1)
+        w2v.fit(CollectionSentenceIterator(sents))
+        bp, tp = str(tmp_path / "v.bin"), str(tmp_path / "v.txt")
+        write_word2vec_binary(w2v, bp)
+        write_word_vectors_text(w2v, tp)
+        assert guess_format(bp) == "word2vec-binary"
+        assert guess_format(tp) == "word-vectors-text"
+        words, vecs = load_model_guess(bp)
+        assert len(words) == vecs.shape[0] == w2v.vocab.num_words()
+
+    def test_keras_h5_detected(self, tmp_path):
+        h5py = pytest.importorskip("h5py")
+        from deeplearning4j_tpu.utils.model_guesser import guess_format
+        p = str(tmp_path / "m.h5")
+        with h5py.File(p, "w"):
+            pass
+        assert guess_format(p) == "keras-h5"
+
+    def test_unknown_rejected(self, tmp_path):
+        from deeplearning4j_tpu.utils.model_guesser import guess_format
+        p = tmp_path / "junk.bin"
+        p.write_bytes(b"\x00\x01\x02\x03garbage")
+        with pytest.raises(ValueError):
+            guess_format(str(p))
+
+
+class TestKerasBackendServer:
+    def test_import_fit_evaluate_predict_over_http(self, tmp_path):
+        keras = pytest.importorskip("keras")
+        from keras import layers
+
+        from deeplearning4j_tpu.modelimport.server import KerasBackendServer
+
+        m = keras.Sequential([
+            layers.Input((4,)),
+            layers.Dense(16, activation="relu"),
+            layers.Dense(3, activation="softmax"),
+        ])
+        m.compile(loss="categorical_crossentropy")
+        h5 = str(tmp_path / "m.h5")
+        m.save(h5)
+
+        rs = np.random.RandomState(0)
+        paths = []
+        for i in range(4):
+            labels = rs.randint(0, 3, 32)
+            p = str(tmp_path / f"b{i}.npz")
+            np.savez(p,
+                     features=(rs.randn(32, 4) + 2 * labels[:, None])
+                     .astype(np.float32),
+                     labels=np.eye(3, dtype=np.float32)[labels])
+            paths.append(p)
+
+        srv = KerasBackendServer()
+        port = srv.start()
+        base = f"http://127.0.0.1:{port}"
+
+        def post(path, payload):
+            req = urllib.request.Request(
+                base + path, json.dumps(payload).encode(),
+                {"Content-Type": "application/json"})
+            try:
+                return json.loads(urllib.request.urlopen(req).read())
+            except urllib.error.HTTPError as e:
+                return json.loads(e.read())
+
+        try:
+            mid = post("/import", {"path": h5})["model"]
+            r = post("/fit", {"model": mid, "batches": paths, "epochs": 20})
+            assert r["iterations"] == 80
+            ev = post("/evaluate", {"model": mid, "batches": paths})
+            assert ev["accuracy"] > 0.8
+            out = post("/predict", {"model": mid,
+                                    "features": [[0.0, 0.0, 0.0, 0.0]]})
+            assert len(out["output"][0]) == 3
+            models = json.loads(
+                urllib.request.urlopen(base + "/models").read())
+            assert mid in models["models"]
+            err = post("/fit", {"model": "nope", "batches": []})
+            assert "error" in err
+        finally:
+            srv.stop()
