@@ -326,6 +326,77 @@ METRIC_UNIT = {
 }
 
 
+# Hard per-benchmark wall-clock cap. A wedged device tunnel makes even
+# jax.devices() block forever; a benchmark that cannot finish in this time
+# is not producing a number anyway, and hanging the round-end bench run is
+# strictly worse than reporting the failure. First-compile of the biggest
+# model through the remote-compile tunnel is minutes-class — 20 min is an
+# order of magnitude of headroom, not a tight budget.
+SUB_BENCH_TIMEOUT_S = 1200
+
+
+# extras snapshot for the hard-exit path: completed metrics are flushed as
+# a JSON line even when a later benchmark wedges beyond recovery
+_COMPLETED_EXTRAS: dict = {}
+
+
+class _Watchdog:
+    """Two-layer wall-clock cap (unix, main thread):
+
+    1. SIGALRM raises TimeoutError at the deadline — recoverable, lets the
+       remaining sub-benchmarks run. Only works for hangs that return to
+       the interpreter (CPython runs signal handlers at bytecode
+       boundaries).
+    2. A daemon Timer thread fires 60s later as the backstop for the hang
+       SIGALRM cannot break: the main thread parked inside a C call (PJRT
+       client init dialing a dead tunnel never returns to Python). It
+       flushes completed metrics as the JSON line and os._exit(1)s —
+       loud partial data beats an eternal hang."""
+
+    GRACE_S = 60
+
+    def __init__(self, seconds: int, label: str):
+        self.seconds = seconds
+        self.label = label
+
+    def __enter__(self):
+        import signal
+        import threading
+
+        def on_alarm(signum, frame):
+            raise TimeoutError(
+                f"{self.label} exceeded {self.seconds}s wall clock — "
+                "wedged device/tunnel?")
+
+        def hard_exit():
+            import os
+            print(f"# {self.label} HARD TIMEOUT after "
+                  f"{self.seconds + self.GRACE_S}s — main thread wedged in "
+                  "a C call (dead tunnel); flushing partial results",
+                  file=sys.stderr, flush=True)
+            print(json.dumps({"metric": "bench_aborted_hard_timeout",
+                              "value": float("nan"), "unit": "",
+                              "vs_baseline": float("nan"),
+                              "aborted_in": self.label,
+                              **_COMPLETED_EXTRAS}), flush=True)
+            os._exit(1)
+
+        self._prev = signal.signal(signal.SIGALRM, on_alarm)
+        signal.alarm(self.seconds)
+        self._timer = threading.Timer(self.seconds + self.GRACE_S,
+                                      hard_exit)
+        self._timer.daemon = True
+        self._timer.start()
+        return self
+
+    def __exit__(self, *exc):
+        import signal
+        self._timer.cancel()
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, self._prev)
+        return False
+
+
 def _sub_metric(extras, key, fn, digits: int = 1):
     """Run one sub-benchmark, isolated: a single wedged/failed sub-metric
     must not take down the whole round-end JSON line (flaky tunnels are a
@@ -334,7 +405,8 @@ def _sub_metric(extras, key, fn, digits: int = 1):
     checked) or a dict of {metric: value} (recorded verbatim — the
     paired stock/flash latency benches)."""
     try:
-        out = fn()
+        with _Watchdog(SUB_BENCH_TIMEOUT_S, key):
+            out = fn()
         if isinstance(out, dict):
             for k, v in out.items():
                 extras[k] = round(v, 3)
@@ -347,6 +419,7 @@ def _sub_metric(extras, key, fn, digits: int = 1):
     except Exception as e:  # noqa: BLE001 — isolate sub-benchmarks
         print(f"# {key} FAILED: {type(e).__name__}: {e}", file=sys.stderr)
         extras[f"{key}_error"] = f"{type(e).__name__}: {e}"[:200]
+    _COMPLETED_EXTRAS.update(extras)  # hard-timeout flush sees these
     return extras.get(key)
 
 
@@ -386,10 +459,13 @@ def main():
         _sub_metric(extras, "resnet50_bf16_img_s",
                     lambda: bench_resnet50(compute_dtype="bfloat16"),
                     digits=2)
-        # the headline metric stays un-wrapped: if ResNet50 f32 cannot run,
-        # the round has no honest primary number and the failure must be
-        # loud, not a quietly missing key
-        v = _sane("resnet50_img_per_sec_per_chip", bench_resnet50())
+        # the headline metric stays exception-un-wrapped: if ResNet50 f32
+        # cannot run, the round has no honest primary number and the
+        # failure must be loud, not a quietly missing key. It still gets
+        # the watchdog — a loud timeout beats an eternal hang.
+        with _Watchdog(SUB_BENCH_TIMEOUT_S,
+                       "resnet50_img_per_sec_per_chip"):
+            v = _sane("resnet50_img_per_sec_per_chip", bench_resnet50())
         result = {
             "metric": "resnet50_img_per_sec_per_chip",
             "value": round(v, 2),
