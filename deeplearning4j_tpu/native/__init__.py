@@ -1,0 +1,103 @@
+"""Native runtime components (C, loaded via ctypes).
+
+The reference's runtime is JVM+native: ND4J C++ kernels for compute,
+JavaCPP-wrapped native IO underneath DataVec ingestion. In the TPU build
+the compute path's native layer IS XLA's C++ runtime (PJRT); this package
+holds the framework's OWN native pieces — currently the data-loader hot
+path (numeric CSV parsing, deeplearning4j_tpu/native/fastio.c).
+
+Build contract: the shared object is compiled ON FIRST USE with the
+toolchain baked into the image (cc -O2 -shared -fPIC), cached next to the
+source, and every consumer falls back to the pure-Python path when the
+toolchain or the build is unavailable — native is an accelerator, never a
+hard dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import shutil
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "_fastio.so")
+_SRC = os.path.join(_DIR, "fastio.c")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        try:
+            stale = (not os.path.exists(_SO)
+                     or os.path.getmtime(_SO) < os.path.getmtime(_SRC))
+        except OSError:
+            # source missing but a built artifact exists: use it as-is
+            stale = not os.path.exists(_SO)
+        if stale:
+            cc = (os.environ.get("CC") or shutil.which("cc")
+                  or shutil.which("gcc"))
+            if cc is None:
+                return None
+            try:
+                subprocess.run([cc, "-O2", "-shared", "-fPIC", "-o", _SO,
+                                _SRC], check=True, capture_output=True,
+                               timeout=120)
+            except (subprocess.SubprocessError, OSError):
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.parse_numeric_csv.restype = ctypes.c_long
+        lib.parse_numeric_csv.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, ctypes.c_char, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_long)]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def parse_numeric_csv(path: str, delimiter: str = ",",
+                      skip_lines: int = 0):
+    """Parse a purely numeric CSV file natively -> float64 [rows, cols],
+    or None when the fast path does not apply (no native lib, non-numeric
+    fields, ragged rows) — callers then use the Python reader."""
+    lib = _load()
+    if lib is None or len(delimiter) != 1:
+        return None
+    try:
+        with open(path, "rb") as f:
+            buf = f.read() + b"\0"  # strtod needs NUL-terminated memory
+    except OSError:
+        return None
+    rows = ctypes.c_long()
+    cols = ctypes.c_long()
+    n = len(buf) - 1
+    # pass 1: validate + count
+    rc = lib.parse_numeric_csv(buf, n, delimiter.encode()[0], skip_lines,
+                               None, ctypes.byref(rows), ctypes.byref(cols))
+    if rc != 0:
+        return None
+    out = np.empty(rows.value * cols.value, np.float64)
+    rc = lib.parse_numeric_csv(
+        buf, n, delimiter.encode()[0], skip_lines,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.byref(rows), ctypes.byref(cols))
+    if rc != 0:
+        return None
+    return out.reshape(rows.value, cols.value)
