@@ -68,7 +68,10 @@ class Evaluation:
 
     # ---- metrics ----------------------------------------------------------
     def _counts(self):
-        cm = self.confusion
+        # nothing evaluated yet (e.g. a zero-batch worker in the
+        # distributed-merge flow): every metric reads as 0, never crashes
+        cm = (self.confusion if self.confusion is not None
+              else np.zeros((1, 1), np.int64))
         tp = np.diag(cm).astype(float)
         fp = cm.sum(axis=0) - tp
         fn = cm.sum(axis=1) - tp
@@ -76,6 +79,8 @@ class Evaluation:
 
     def accuracy(self) -> float:
         cm = self.confusion
+        if cm is None:
+            return 0.0  # nothing evaluated yet
         total = cm.sum()
         return float(np.diag(cm).sum() / total) if total else 0.0
 
@@ -118,6 +123,35 @@ class Evaluation:
         tn = cm.sum() - tp[cls] - fp[cls] - fn[cls]
         d = fp[cls] + tn
         return float(fp[cls] / d) if d else 0.0
+
+    def to_json(self) -> str:
+        """Serialize counts + config (reference: BaseEvaluation.toJson —
+        the transport format for merging eval results across workers)."""
+        import json
+        return json.dumps({
+            "@class": "Evaluation",
+            "num_classes": self.num_classes,
+            "labels": self.label_names,
+            "top_n": self.top_n,
+            "top_n_correct": self.top_n_correct,
+            "top_n_total": self.top_n_total,
+            "confusion": (self.confusion.tolist()
+                          if self.confusion is not None else None),
+        })
+
+    @staticmethod
+    def from_json(s: str) -> "Evaluation":
+        import json
+        d = json.loads(s)
+        if d.get("@class") != "Evaluation":
+            raise ValueError("not an Evaluation json")
+        ev = Evaluation(num_classes=d["num_classes"], labels=d["labels"],
+                        top_n=d["top_n"])
+        ev.top_n_correct = d["top_n_correct"]
+        ev.top_n_total = d["top_n_total"]
+        if d["confusion"] is not None:
+            ev.confusion = np.asarray(d["confusion"], np.int64)
+        return ev
 
     def stats(self) -> str:
         names = self.label_names or [str(i) for i in range(self.num_classes or 0)]

@@ -1,0 +1,66 @@
+"""Simple classification-result helpers.
+
+Reference: nn/simple/multiclass/RankClassificationResult.java (rank class
+probabilities per example, expose ranked labels) and
+nn/simple/binary/BinaryClassificationResult (thresholded binary view).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+
+class RankClassificationResult:
+    """Ranked view over class probabilities [B, C] (reference:
+    RankClassificationResult.java)."""
+
+    def __init__(self, probabilities, labels: Optional[List[str]] = None):
+        self.probabilities = np.asarray(probabilities)
+        if self.probabilities.ndim != 2:
+            raise ValueError("probabilities must be [batch, classes]")
+        c = self.probabilities.shape[1]
+        self.labels = (list(labels) if labels is not None
+                       else [str(i) for i in range(c)])
+        if len(self.labels) != c:
+            raise ValueError("labels length != number of classes")
+        # descending probability order per example
+        self._order = np.argsort(-self.probabilities, axis=1)
+
+    def ranked_classes(self, example: int) -> List[str]:
+        """All labels for one example, best first."""
+        return [self.labels[j] for j in self._order[example]]
+
+    def max_output(self) -> List[str]:
+        """Top-1 label per example."""
+        return [self.labels[j] for j in self._order[:, 0]]
+
+    def probability(self, example: int, label: str) -> float:
+        return float(self.probabilities[example, self.labels.index(label)])
+
+
+class BinaryClassificationResult:
+    """Thresholded binary view over probabilities [B] / [B,1] / [B,2]
+    (reference: nn/simple/binary/)."""
+
+    def __init__(self, probabilities, threshold: float = 0.5):
+        p = np.asarray(probabilities)
+        if p.ndim == 2:
+            if p.shape[1] > 2:
+                raise ValueError(
+                    f"binary result needs [B], [B,1] or [B,2] input, got "
+                    f"{p.shape} — use RankClassificationResult for "
+                    "multiclass output")
+            p = p[:, 1] if p.shape[1] == 2 else p[:, 0]
+        elif p.ndim != 1:
+            raise ValueError(f"binary result needs [B], [B,1] or [B,2] "
+                             f"input, got {p.shape}")
+        self.probabilities = p
+        self.threshold = float(threshold)
+
+    def decisions(self) -> np.ndarray:
+        return (self.probabilities >= self.threshold).astype(np.int64)
+
+    def positive_count(self) -> int:
+        return int(self.decisions().sum())

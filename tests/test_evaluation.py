@@ -100,3 +100,52 @@ class TestCurveSerde:
         except ValueError:
             return
         raise AssertionError("expected ValueError")
+
+
+class TestEvaluationSerde:
+    def test_round_trip_preserves_metrics_and_merge(self):
+        rs = np.random.RandomState(3)
+        probs = rs.rand(64, 4)
+        labels = np.eye(4)[rs.randint(0, 4, 64)]
+        ev = Evaluation(labels=["w", "x", "y", "z"], top_n=2)
+        ev.eval(labels, probs)
+        back = Evaluation.from_json(ev.to_json())
+        assert back.accuracy() == ev.accuracy()
+        assert back.top_n_accuracy() == ev.top_n_accuracy()
+        assert back.label_names == ["w", "x", "y", "z"]
+        np.testing.assert_array_equal(back.confusion, ev.confusion)
+        # the transport use-case: merge a deserialized remote result
+        ev2 = Evaluation(top_n=2).eval(labels, probs)
+        ev2.merge(back)
+        assert ev2.confusion.sum() == 128
+
+    def test_empty_round_trip(self):
+        back = Evaluation.from_json(Evaluation().to_json())
+        assert back.accuracy() == 0.0 and back.confusion is None
+        # every sibling metric must also survive the empty case
+        assert back.precision() == back.recall() == back.f1() == 0.0
+        assert isinstance(back.stats(), str)
+
+
+class TestSimpleResults:
+    def test_rank_classification(self):
+        from deeplearning4j_tpu.nn.simple import RankClassificationResult
+        probs = np.array([[0.1, 0.7, 0.2], [0.5, 0.2, 0.3]])
+        r = RankClassificationResult(probs, labels=["a", "b", "c"])
+        assert r.max_output() == ["b", "a"]
+        assert r.ranked_classes(0) == ["b", "c", "a"]
+        assert r.probability(1, "c") == 0.3
+
+    def test_binary_result(self):
+        from deeplearning4j_tpu.nn.simple import BinaryClassificationResult
+        r = BinaryClassificationResult(np.array([[0.3, 0.7], [0.9, 0.1]]))
+        np.testing.assert_array_equal(r.decisions(), [1, 0])
+        assert r.positive_count() == 1
+        r2 = BinaryClassificationResult([0.2, 0.6, 0.9], threshold=0.8)
+        np.testing.assert_array_equal(r2.decisions(), [0, 0, 1])
+        try:
+            BinaryClassificationResult(np.zeros((4, 3)))
+        except ValueError:
+            pass
+        else:
+            raise AssertionError("multiclass input must be rejected")
