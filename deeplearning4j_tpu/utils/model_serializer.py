@@ -84,6 +84,19 @@ def save_model(net, path: str, save_updater: bool = True) -> None:
             zf.writestr("updaterState.bin", _state_to_npz(net.updater_state))
 
 
+def net_from_conf(conf):
+    """Build + init the right network class for a deserialized config —
+    the ONE dispatch shared by every loader (zip, orbax)."""
+    if hasattr(conf, "vertices"):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        return ComputationGraph(conf).init()
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    if hasattr(conf, "preprocessors"):
+        conf.preprocessors = {int(k): v
+                              for k, v in conf.preprocessors.items()}
+    return MultiLayerNetwork(conf).init()
+
+
 def load_model(path: str, load_updater: bool = True):
     """Restore a model zip -> initialised network with params/state/updater."""
     with zipfile.ZipFile(path, "r") as zf:
@@ -94,14 +107,7 @@ def load_model(path: str, load_updater: bool = True):
         upd = (_npz_to_state(zf.read("updaterState.bin"))
                if load_updater and "updaterState.bin" in zf.namelist() else None)
 
-    if meta["model_type"] == "ComputationGraph":
-        from deeplearning4j_tpu.nn.graph import ComputationGraph
-        net = ComputationGraph(conf).init()
-    else:
-        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-        if hasattr(conf, "preprocessors"):
-            conf.preprocessors = {int(k): v for k, v in conf.preprocessors.items()}
-        net = MultiLayerNetwork(conf).init()
+    net = net_from_conf(conf)
     net.set_params_flat(coeff)
     if state:
         net.state = _merge_into(net.state, state)

@@ -1,0 +1,81 @@
+"""Sharded training-state checkpoints via orbax.
+
+Reference role: util/ModelSerializer.java (zip of config + coefficients +
+updater state) — which utils/model_serializer.py ports faithfully. That
+path gathers every array to one host process; for GSPMD-sharded training
+(parallel/model_sharding.py, nlp/distributed.py) a [V, D] or multi-GB
+parameter tree may not even fit one host. Orbax writes each shard from
+the device that owns it and restores arrays WITH their shardings, so a
+sharded training job resumes sharded.
+
+Layout: ``<dir>/state`` (orbax pytree: params/updater_state/state +
+iteration/epoch counters) + ``<dir>/configuration.json`` (same builder
+JSON the zip format stores) — config stays human-readable, tensors stay
+shard-parallel.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+
+
+def save_checkpoint(net, path: str) -> None:
+    """Write a resumable checkpoint of ``net`` (MultiLayerNetwork or
+    ComputationGraph) to directory ``path``."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    # multi-host: exactly one process writes the shared config file (the
+    # tensor shards are per-process by construction, orbax coordinates
+    # those itself)
+    if jax.process_index() == 0:
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "configuration.json"), "w",
+                  encoding="utf-8") as f:
+            f.write(net.conf.to_json())
+    state = {
+        "params": net.params,
+        "updater_state": net.updater_state,
+        "state": net.state,
+        "counters": {"iteration": int(net.iteration),
+                     "epoch": int(getattr(net, "epoch", 0))},
+    }
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.join(path, "state"), state, force=True)
+        ckptr.wait_until_finished()
+
+
+def load_checkpoint(path: str, net=None):
+    """Restore from ``path``. With ``net`` given, its arrays' CURRENT
+    shardings are the restore targets (a mesh-sharded net restores
+    sharded, each host reading its shards); without, the net is rebuilt
+    from configuration.json and restored unsharded."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    if net is None:
+        from deeplearning4j_tpu.utils import serde
+        from deeplearning4j_tpu.utils.model_serializer import net_from_conf
+        with open(os.path.join(path, "configuration.json"),
+                  encoding="utf-8") as f:
+            net = net_from_conf(serde.from_json(f.read()))
+    target = {
+        "params": net.params,
+        "updater_state": net.updater_state,
+        "state": net.state,
+        "counters": {"iteration": 0, "epoch": 0},
+    }
+    abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
+                                      target)
+    with ocp.StandardCheckpointer() as ckptr:
+        state = ckptr.restore(os.path.join(path, "state"), abstract)
+    net.params = state["params"]
+    net.updater_state = state["updater_state"]
+    net.state = state["state"]
+    net.iteration = int(state["counters"]["iteration"])
+    if hasattr(net, "epoch"):
+        net.epoch = int(state["counters"]["epoch"])
+    return net
