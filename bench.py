@@ -14,8 +14,8 @@ img/s on the 2017 GPUs the reference targeted (K80/GTX1080 class) => target
 84 img/s. vs_baseline = measured / 84.0, i.e. 1.0 means the north star is
 met; >1 beats it.
 
-Usage: python bench.py [model]   (model: resnet50 | lenet | lstm | all;
-default all, headline = resnet50)
+Usage: python bench.py [model]   (model: resnet50 | lenet | lstm |
+word2vec | doc2vec | attention | all; default all, headline = resnet50)
 """
 
 from __future__ import annotations
