@@ -35,3 +35,4 @@ from deeplearning4j_tpu.parallel.parameter_server import (
 from deeplearning4j_tpu.parallel.early_stopping import (
     EarlyStoppingParallelTrainer,
 )
+from deeplearning4j_tpu.parallel.pipeline import PipelineTrainer
