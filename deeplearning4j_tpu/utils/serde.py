@@ -46,6 +46,19 @@ def to_jsonable(obj: Any) -> Any:
     raise TypeError(f"Cannot serialise {type(obj)!r} to JSON")
 
 
+def _ensure_registry() -> None:
+    """Import every module that defines @register_serializable classes, so
+    deserialization works in a process that never imported them (e.g.
+    ``load_model(path)`` as the very first call)."""
+    import deeplearning4j_tpu.nn.conf.builders  # noqa: F401
+    import deeplearning4j_tpu.nn.conf.graph_conf  # noqa: F401
+    import deeplearning4j_tpu.nn.conf.layers  # noqa: F401
+    import deeplearning4j_tpu.nn.conf.layers.attention  # noqa: F401
+    import deeplearning4j_tpu.nn.conf.preprocessors  # noqa: F401
+    import deeplearning4j_tpu.nn.transferlearning  # noqa: F401
+    import deeplearning4j_tpu.nn.updater  # noqa: F401
+
+
 def from_jsonable(d: Any) -> Any:
     from deeplearning4j_tpu.ops.activations import get_activation
     from deeplearning4j_tpu.ops.losses import get_loss
@@ -59,6 +72,11 @@ def from_jsonable(d: Any) -> Any:
             return get_loss(d["@loss"])
         if "@class" in d:
             name = d["@class"]
+            if name not in _CLASSES:
+                # registrations happen at class definition; in a fresh
+                # process that only imported the loader, the defining
+                # modules may not be loaded yet — pull them in once
+                _ensure_registry()
             if name not in _CLASSES:
                 raise ValueError(f"Unknown config class '{name}' in JSON")
             cls = _CLASSES[name]
