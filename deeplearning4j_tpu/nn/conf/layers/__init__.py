@@ -54,3 +54,4 @@ from deeplearning4j_tpu.nn.conf.layers.misc import (
     CenterLossOutputLayer,
 )
 from deeplearning4j_tpu.nn.conf.layers.rbm import RBM
+from deeplearning4j_tpu.nn.conf.layers.moe import MixtureOfExpertsLayer

@@ -1,0 +1,119 @@
+"""Mixture-of-Experts layer: top-k routed expert FFNs.
+
+Beyond reference parity (SURVEY §2.4 taxonomy: "EP (expert parallel /
+MoE): absent" in DL4J; the charter lists modern-parallelism coverage as an
+idiomatic TPU extension). Design choices:
+
+- **Dense dispatch**: every token computes through every expert and the
+  top-k softmax gate weights combine them. No capacity factor, no token
+  dropping, no ragged all-to-all — the einsums stay static-shaped and
+  MXU-tiled, and the math EXACTLY equals ideal (infinite-capacity) sparse
+  MoE routing. The FLOPs saving of sparse dispatch only pays past E~16
+  experts with balanced loads; for the moderate-E regime this layer
+  targets, dense is both faster on TPU and simpler to shard.
+- **Expert parallelism via GSPMD**: the stacked expert params [E, ...]
+  shard on their leading expert axis over the mesh model axis
+  (parallel/model_sharding.py recognises this layer) — each device owns
+  E/m experts, XLA partitions the expert einsums and inserts the combine
+  reduction over ICI. Sharded == single-device, parity-tested.
+- **load_balance_coef** is a UNIFORM-ROUTING PULL, not the Switch-style
+  batch auxiliary: it penalizes the gate weights' L2 norm, nudging
+  routing toward uniform when the data gives no signal. The Switch
+  auxiliary (gate-probability x realized usage fraction) needs batch
+  statistics from inside forward, which the per-layer loss plumbing does
+  not carry — a deliberate scope cut, stated here so nobody mistakes the
+  knob for collapse protection. Dense dispatch makes collapse benign for
+  correctness (no capacity overflow), only for specialization quality.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.layers.base import FeedForwardLayer
+from deeplearning4j_tpu.ops.activations import get_activation
+from deeplearning4j_tpu.utils.serde import register_serializable
+
+
+@register_serializable
+@dataclass
+class MixtureOfExpertsLayer(FeedForwardLayer):
+    """y = sum_{e in topk} softmax_gate_e(x) * FFN_e(x).
+
+    Input [B, F] or [B, T, F]; each expert is a 2-layer FFN with hidden
+    width ``expert_hidden`` (defaults to 4 * n_out, the transformer
+    convention)."""
+
+    n_experts: int = 4
+    top_k: int = 2
+    expert_hidden: int = 0
+    activation: str = "relu"
+    load_balance_coef: float = 0.0
+
+    def finalize(self, g=None) -> None:
+        super().finalize(g)
+        if self.expert_hidden == 0:
+            self.expert_hidden = 4 * self.n_out
+        if not 1 <= self.top_k <= self.n_experts:
+            raise ValueError(f"top_k {self.top_k} not in [1, n_experts "
+                             f"{self.n_experts}]")
+
+    def param_order(self):
+        return ("Wg", "W1", "b1", "W2", "b2")
+
+    def init_params(self, rng, dtype=jnp.float32):
+        kg, k1, k2 = jax.random.split(rng, 3)
+        E, D, H, O = (self.n_experts, self.n_in, self.expert_hidden,
+                      self.n_out)
+        return {
+            "Wg": self._init_w(kg, (D, E), D, E, dtype),
+            "W1": self._init_w(k1, (E, D, H), D, H, dtype),
+            "b1": jnp.zeros((E, H), dtype),
+            "W2": self._init_w(k2, (E, H, O), H, O, dtype),
+            "b2": jnp.zeros((E, O), dtype),
+        }
+
+    def bias_param_names(self):
+        return frozenset(("b1", "b2"))
+
+    def _gate(self, params, x):
+        """[..., E] combine weights: softmax over ALL experts, then top-k
+        mask + renormalize (gradients flow through the kept gates).
+        Selection is by ``lax.top_k`` INDICES, not a >=threshold test, so
+        exactly top_k experts are kept even under ties (uniform logits
+        from a zero-padded token would otherwise keep all E)."""
+        logits = jnp.einsum("...d,de->...e", x, params["Wg"])
+        probs = jax.nn.softmax(logits, axis=-1)
+        if self.top_k < self.n_experts:
+            _, idx = jax.lax.top_k(probs, self.top_k)
+            mask = jnp.sum(jax.nn.one_hot(idx, self.n_experts,
+                                          dtype=probs.dtype), axis=-2)
+            kept = probs * mask
+            probs = kept / jnp.maximum(
+                jnp.sum(kept, axis=-1, keepdims=True), 1e-9)
+        return probs
+
+    def forward(self, params, state, x, *, mask=None, train=False,
+                rng=None):
+        x = self.apply_input_dropout(x, train=train, rng=rng)
+        gates = self._gate(params, x)                       # [..., E]
+        act = get_activation(self.activation)
+        h = act(jnp.einsum("...d,edh->...eh", x, params["W1"])
+                + params["b1"])
+        y = jnp.einsum("...eh,eho->...eo", h, params["W2"]) + params["b2"]
+        out = jnp.einsum("...e,...eo->...o", gates, y)
+        return out, state
+
+    def regularization(self, params):
+        reg = super().regularization(params)
+        # the Switch-style auxiliary needs gate statistics, which only
+        # exist inside forward; a coefficient without batch statistics
+        # reduces to an L2-like pull on the gate weights toward uniform
+        # routing — documented approximation, off by default
+        if self.load_balance_coef:
+            reg = reg + self.load_balance_coef * jnp.sum(
+                jnp.square(params["Wg"]))
+        return reg

@@ -42,12 +42,18 @@ __all__ = ["network_param_specs", "shard_network", "ShardedTrainer",
            "data_batch_sharding"]
 
 
-def _leaf_spec(arr, model_size: int, *, embedding: bool) -> P:
+def _leaf_spec(arr, model_size: int, *, embedding: bool,
+               expert: bool = False) -> P:
     shape = np.shape(arr)
     if len(shape) == 0:
         return P()
     if embedding and len(shape) == 2 and shape[0] % model_size == 0:
         return P(MODEL_AXIS, None)  # vocab-row sharding
+    if expert and len(shape) >= 2 and shape[0] % model_size == 0:
+        # stacked-expert tensors [E, ...]: shard the EXPERT axis — each
+        # device owns E/m experts (expert parallelism); XLA partitions the
+        # per-expert einsums and reduces the gate-combine over ICI
+        return P(*([MODEL_AXIS] + [None] * (len(shape) - 1)))
     if shape[-1] % model_size == 0 and shape[-1] >= model_size:
         return P(*([None] * (len(shape) - 1) + [MODEL_AXIS]))
     return P()
@@ -71,8 +77,11 @@ def network_param_specs(net, model_size: int) -> dict:
     for key, sub in net.params.items():
         layer = _layer_of(net, key)
         is_emb = type(layer).__name__ == "EmbeddingLayer"
-        specs[key] = {name: _leaf_spec(arr, model_size, embedding=is_emb)
-                      for name, arr in sub.items()}
+        is_moe = type(layer).__name__ == "MixtureOfExpertsLayer"
+        specs[key] = {
+            name: _leaf_spec(arr, model_size, embedding=is_emb,
+                             expert=is_moe and name != "Wg")
+            for name, arr in sub.items()}
     return specs
 
 
