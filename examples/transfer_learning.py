@@ -1,0 +1,71 @@
+"""Freeze a trained feature stack and retrain a new head — the
+dl4j-examples TransferLearning (EditLastLayerOthersFrozen) analog.
+
+Run: python examples/transfer_learning.py
+Env: EXAMPLES_SMOKE=1 shrinks sizes.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SMOKE = bool(os.environ.get("EXAMPLES_SMOKE"))
+if SMOKE:  # the smoke run must be hermetic: never touch a real device
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.transferlearning import TransferLearning
+from deeplearning4j_tpu.nn.updater import Adam
+
+
+
+def main():
+    rs = np.random.RandomState(0)
+    n = 256 if SMOKE else 2048
+    # source task: 4-class problem
+    labels4 = rs.randint(0, 4, n)
+    x = (rs.randn(n, 8) + labels4[:, None]).astype(np.float32)
+    base_conf = (NeuralNetConfiguration.builder()
+                 .seed(1).updater(Adam(learning_rate=0.01))
+                 .list(DenseLayer(n_out=32, activation="relu"),
+                       DenseLayer(n_out=16, activation="relu"),
+                       OutputLayer(n_out=4, activation="softmax",
+                                   loss="mcxent"))
+                 .set_input_type(InputType.feed_forward(8)).build())
+    base = MultiLayerNetwork(base_conf).init()
+    ds4 = DataSet(x, np.eye(4, dtype=np.float32)[labels4])
+    for _ in range(15 if SMOKE else 60):
+        base.fit(ds4)
+    print("source-task score:", round(base.score_value, 4))
+
+    # target task: binary relabeling, freeze the feature stack
+    labels2 = (labels4 >= 2).astype(int)
+    ds2 = DataSet(x, np.eye(2, dtype=np.float32)[labels2])
+    transferred = (TransferLearning.Builder(base)
+                   .set_feature_extractor(1)     # freeze layers 0..1
+                   .remove_output_layer()
+                   .add_layer(OutputLayer(n_out=2, activation="softmax",
+                                          loss="mcxent"))
+                   .build())
+    frozen_before = np.asarray(transferred.params["0"]["W"]).copy()
+    for _ in range(15 if SMOKE else 60):
+        transferred.fit(ds2)
+    frozen_after = np.asarray(transferred.params["0"]["W"])
+    ev = transferred.evaluate(ds2)
+    print("target-task accuracy:", round(ev.accuracy(), 3))
+    print("frozen layer untouched:", np.array_equal(frozen_before,
+                                                    frozen_after))
+    print("TRAINED iterations:", transferred.iteration)
+    return ev.accuracy()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+"""Train Word2Vec on a text corpus and query word similarity — the
+dl4j-examples Word2VecRawTextExample analog.
+
+Run: python examples/word2vec_similarity.py [corpus.txt]
+Without a corpus file a small synthetic two-topic corpus is generated.
+Env: EXAMPLES_SMOKE=1 shrinks sizes.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SMOKE = bool(os.environ.get("EXAMPLES_SMOKE"))
+if SMOKE:  # the smoke run must be hermetic: never touch a real device
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp import CollectionSentenceIterator, Word2Vec
+from deeplearning4j_tpu.nlp.serde import write_word2vec_binary
+
+
+
+def synthetic_corpus(n):
+    rs = np.random.RandomState(7)
+    day = ["day", "sun", "light", "bright", "warm", "sky"]
+    night = ["night", "moon", "dark", "star", "cold", "quiet"]
+    out = []
+    for _ in range(n):
+        topic = day if rs.rand() < 0.5 else night
+        out.append(" ".join(topic[rs.randint(len(topic))]
+                            for _ in range(12)))
+    return out
+
+
+def main():
+    if len(sys.argv) > 1:
+        with open(sys.argv[1], encoding="utf-8") as f:
+            sentences = [ln.strip() for ln in f if ln.strip()]
+    else:
+        sentences = synthetic_corpus(400 if SMOKE else 5000)
+    w2v = Word2Vec(layer_size=64 if not SMOKE else 24, window=5,
+                   min_word_frequency=2, negative=5,
+                   use_hierarchic_softmax=False,
+                   epochs=3 if SMOKE else 5, learning_rate=0.05, seed=42)
+    w2v.fit(CollectionSentenceIterator(sentences))
+    probe = "sun" if w2v.has_word("sun") else \
+        w2v.vocab.vocab_words()[0].word
+    print(f"nearest({probe}):")
+    for word, sim in w2v.words_nearest(probe, 5):
+        print(f"  {word:>12}  {sim:.3f}")
+    out = "/tmp/word_vectors.bin"
+    write_word2vec_binary(w2v, out)
+    print("vectors saved to", out)
+    trained = int(np.linalg.norm(np.asarray(w2v.syn0)) > 0)
+    print("TRAINED iterations:", len(sentences) * trained)
+
+
+if __name__ == "__main__":
+    main()
