@@ -1,0 +1,89 @@
+"""Train with the stats pipeline attached and export a self-contained
+HTML report — the dl4j-examples UI/HistogramIterationListener analog
+(file-based: a pod worker has no browser).
+
+Run: python examples/training_report.py   (writes /tmp/dl4j_tpu_report.html)
+Env: EXAMPLES_SMOKE=1 shrinks sizes and forces CPU.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SMOKE = bool(os.environ.get("EXAMPLES_SMOKE"))
+if SMOKE:  # the smoke run must be hermetic: never touch a real device
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Adam
+from deeplearning4j_tpu.ui import (
+    ChartHistogram,
+    ChartLine,
+    ChartMatrix,
+    ComponentTable,
+    ComponentText,
+    InMemoryStatsStorage,
+    StatsListener,
+    render_html_file,
+)
+from deeplearning4j_tpu.ui.stats import TYPE_ID
+
+
+def main():
+    storage = InMemoryStatsStorage()
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).updater(Adam(learning_rate=0.01))
+            .list(DenseLayer(n_out=32, activation="relu"),
+                  OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    net.set_listeners(StatsListener(storage, session_id="report",
+                                    reporting_frequency=1,
+                                    collect_histograms=True))
+    rs = np.random.RandomState(0)
+    labels = rs.randint(0, 3, 128)
+    ds = DataSet((rs.randn(128, 4) + labels[:, None]).astype(np.float32),
+                 np.eye(3, dtype=np.float32)[labels])
+    for _ in range(15 if SMOKE else 60):
+        net.fit(ds)
+
+    updates = storage.get_all_updates_after("report", TYPE_ID)
+    iters = [u["data"]["iteration"] for u in updates]
+    scores = [u["data"]["score"] for u in updates]
+    ev = net.evaluate(ds)
+    hist_data = updates[-1]["data"]["param_histograms"]["0/W"]
+    edges = np.linspace(hist_data["min"], hist_data["max"],
+                        len(hist_data["counts"]) + 1)
+    hist = ChartHistogram(title="layer 0 weights")
+    for i, c in enumerate(hist_data["counts"]):
+        hist.add_bin(edges[i], edges[i + 1], c)
+    components = [
+        ComponentText(text="Training report"),
+        ChartLine(title="score").add_series("train", iters, scores),
+        hist,
+        ChartMatrix(title="confusion matrix",
+                    values=[[int(v) for v in row]
+                            for row in ev.confusion],
+                    row_labels=["0", "1", "2"], col_labels=["0", "1", "2"]),
+        ComponentTable(header=["metric", "value"],
+                       content=[["accuracy", f"{ev.accuracy():.4f}"],
+                                ["f1", f"{ev.f1():.4f}"],
+                                ["final score", f"{scores[-1]:.4f}"]]),
+    ]
+    out = "/tmp/dl4j_tpu_report.html"
+    render_html_file(components, out, title="training report")
+    print("report written to", out,
+          f"({os.path.getsize(out)} bytes)")
+    print("TRAINED iterations:", net.iteration)
+
+
+if __name__ == "__main__":
+    main()
