@@ -57,11 +57,15 @@ def _segment_row_add(row_idx, updates, weights, cap, stacked):
     scatter: sort by destination row, per-row-count dup_cap scale, segment
     sums, then ONE scatter whose indices are provably sorted and unique.
 
-    Rationale (TPU): XLA lowers a scatter-add with possibly-duplicate
-    indices to a serialized per-row loop — the measured round-3 word2vec
-    bottleneck (6 such scatters per 8192-pair batch). Sorting first costs
-    one 32-bit argsort + two segment sums (both parallel) and converts the
-    scatter into the unique+sorted form the backend can vectorize.
+    Rationale (historical): the round-3 hypothesis was that XLA lowers a
+    duplicate-index scatter-add to a serialized per-row loop on TPU, making
+    the sort-then-unique-scatter form faster. The round-4 A/B on the real
+    v5e chip REFUTED this: the plain ``.at[].add`` path measures ~3x faster
+    end-to-end (184k vs 49k words/s at batch 8192, 128k vs 67k at 16384 —
+    profiles/chip_session_results.json), because the argsort dominates.
+    ``segment_updates`` therefore defaults to False everywhere; this path
+    is kept as a tested alternative for backends where duplicate scatters
+    do serialize.
     Numerically identical to the `.at[].add` path up to float summation
     order (same per-element min(1, cap/count) scale as _row_mean_scale).
 
@@ -144,7 +148,7 @@ def skipgram_corpus_epoch(syn0, syn1, syn1neg, tokens, key,
                           lr_start, lr_end, dup_cap, points_tab, codes_tab,
                           cmask_tab, neg_table, *, window: int, batch: int,
                           neg_k: int, use_hs: bool, use_ns: bool,
-                          segment_updates: bool = True):
+                          segment_updates: bool = False):
     """One skipgram epoch generated AND trained on device.
 
     The round-3 v1 fast path staged pre-built pair/negative batches from
@@ -344,7 +348,7 @@ def cbow_corpus_epoch(syn0, syn1, syn1neg, tokens, labels, key, lr_start,
                       lr_end, dup_cap, label_cap, points_tab, codes_tab,
                       cmask_tab, neg_table, *, window: int, batch: int,
                       neg_k: int, use_hs: bool, use_ns: bool,
-                      with_labels: bool, segment_updates: bool = True):
+                      with_labels: bool, segment_updates: bool = False):
     """One CBOW epoch on device — and, with_labels=True, one doc2vec DM
     epoch (reference: elements/CBOW.java, sequence/DM.java).
 
@@ -484,7 +488,7 @@ def dbow_corpus_epoch(syn0, syn1, syn1neg, tokens, labels, key, lr_start,
                       lr_end, dup_cap, label_cap, points_tab, codes_tab,
                       cmask_tab, neg_table, *, batch: int, neg_k: int,
                       use_hs: bool, use_ns: bool,
-                      segment_updates: bool = True):
+                      segment_updates: bool = False):
     """One doc2vec DBOW epoch on device (reference: sequence/DBOW.java):
     the document's label row predicts every document word — the skipgram
     inner loop with rows = ``labels`` [N] (syn0 row per position, -1 =

@@ -58,8 +58,10 @@ def main():
     print(f"probe accuracy: {correct}/3")
     # the sentinel signals TRAINING HAPPENED (weights moved), never
     # prediction luck — a correct model with unlucky probes must not
-    # read as "trained zero steps"
-    trained = int(np.linalg.norm(np.asarray(pv.syn0)) > 0)
+    # read as "trained zero steps". syn1neg starts at exactly zero and
+    # only moves with training steps; syn0's random init would always
+    # pass a norm check
+    trained = int(np.linalg.norm(np.asarray(pv.syn1neg)) > 0)
     print("TRAINED iterations:", len(docs) * trained)
     return correct
 
