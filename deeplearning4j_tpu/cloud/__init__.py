@@ -1,0 +1,13 @@
+"""Cloud provisioning for TPU training fleets.
+
+The TPU-native analog of the reference's deeplearning4j-aws module
+(deeplearning4j-scaleout/deeplearning4j-aws/): EC2 box creation + SSH
+provisioning + S3 transfer become GCP TPU-VM lifecycle + SSH fan-out +
+GCS transfer, all through the ``gcloud``/``gsutil`` CLIs.
+"""
+
+from deeplearning4j_tpu.cloud.provision import (
+    ClusterSetup,
+    GcsTransfer,
+    TpuVmProvisioner,
+)

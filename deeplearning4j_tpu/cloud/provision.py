@@ -1,0 +1,170 @@
+"""TPU-VM fleet provisioning over the gcloud CLI.
+
+Reference analog (deeplearning4j-scaleout/deeplearning4j-aws):
+- ``Ec2BoxCreator`` (aws/ec2/Ec2BoxCreator.java — runInstances/blockUntilAll)
+  -> ``TpuVmProvisioner``: create/list/delete TPU VMs and wait for READY.
+- ``HostProvisioner`` / ``ClusterSetup`` (aws/ec2/provision/ — SSH file push
+  + remote command runner + distributed launch)
+  -> ``ClusterSetup``: push the training package to every worker of a pod
+  slice and launch the ``jax.distributed`` run on all workers.
+- ``S3Uploader`` / ``S3Downloader`` (aws/s3/) -> ``GcsTransfer`` via gsutil.
+
+Everything builds explicit argv lists. ``dry_run=True`` records the argv
+instead of executing, which is what the tests assert against — the same
+commands run verbatim against a real project when ``dry_run=False``
+(``gcloud`` must be on PATH; nothing in this module imports cloud SDKs).
+"""
+
+from __future__ import annotations
+
+import shlex
+import subprocess
+import time
+from typing import List, Optional, Sequence
+
+
+class CommandRunner:
+    """Executes (or, dry_run, records) argv lists. One seam for tests and
+    for the real CLI; keeps provisioning logic free of subprocess details."""
+
+    def __init__(self, dry_run: bool = False):
+        self.dry_run = dry_run
+        self.history: List[List[str]] = []
+        self.canned: dict = {}  # prefix tuple -> stdout (dry-run responses)
+
+    def run(self, argv: Sequence[str], check: bool = True) -> str:
+        argv = list(argv)
+        self.history.append(argv)
+        if self.dry_run:
+            for prefix, out in self.canned.items():
+                if tuple(argv[:len(prefix)]) == tuple(prefix):
+                    return out
+            return ""
+        proc = subprocess.run(argv, capture_output=True, text=True)
+        if check and proc.returncode != 0:
+            raise RuntimeError(
+                f"command failed ({proc.returncode}): "
+                f"{shlex.join(argv)}\n{proc.stderr}")
+        return proc.stdout
+
+    def script(self) -> str:
+        """The recorded session as a copy-pasteable shell script."""
+        return "\n".join(shlex.join(argv) for argv in self.history)
+
+
+class TpuVmProvisioner:
+    """Create / inspect / delete TPU VMs (reference: Ec2BoxCreator.create
+    + blockTillAllRunning)."""
+
+    def __init__(self, project: str, zone: str, runner: CommandRunner):
+        self.project = project
+        self.zone = zone
+        self.runner = runner
+
+    def _gcloud(self, *args: str) -> List[str]:
+        return ["gcloud", "compute", "tpus", "tpu-vm", *args,
+                f"--project={self.project}", f"--zone={self.zone}",
+                "--quiet"]
+
+    def create(self, name: str, accelerator_type: str = "v5litepod-16",
+               version: str = "v2-alpha-tpuv5-lite",
+               preemptible: bool = False) -> None:
+        argv = self._gcloud("create", name,
+                            f"--accelerator-type={accelerator_type}",
+                            f"--version={version}")
+        if preemptible:
+            argv.append("--preemptible")
+        self.runner.run(argv)
+
+    def describe(self, name: str) -> str:
+        return self.runner.run(
+            self._gcloud("describe", name, "--format=value(state)"))
+
+    def wait_until_ready(self, name: str, timeout_s: float = 600,
+                         poll_s: float = 10) -> None:
+        """Poll until state == READY (Ec2BoxCreator.blockTillAllRunning)."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            state = self.describe(name).strip()
+            if state == "READY":
+                return
+            if self.runner.dry_run:
+                return  # recorded the poll; nothing to wait for
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"TPU VM {name} not READY after {timeout_s}s "
+                    f"(state={state!r})")
+            time.sleep(poll_s)
+
+    def delete(self, name: str) -> None:
+        self.runner.run(self._gcloud("delete", name))
+
+    def ssh(self, name: str, command: str,
+            worker: str = "all") -> str:
+        """Run a command on pod workers (HostProvisioner.runRemoteCommand)."""
+        return self.runner.run(
+            self._gcloud("ssh", name, f"--worker={worker}",
+                         f"--command={command}"))
+
+    def scp(self, name: str, local: str, remote: str,
+            worker: str = "all") -> None:
+        """Push a file to pod workers (HostProvisioner.uploadFile)."""
+        self.runner.run(
+            self._gcloud("scp", local, f"{name}:{remote}",
+                         f"--worker={worker}"))
+
+
+class GcsTransfer:
+    """gsutil up/down (reference: s3/uploader/S3Uploader.java,
+    s3/reader/S3Downloader.java)."""
+
+    def __init__(self, runner: CommandRunner):
+        self.runner = runner
+
+    def upload(self, local: str, gcs_uri: str) -> None:
+        if not gcs_uri.startswith("gs://"):
+            raise ValueError(f"not a GCS uri: {gcs_uri}")
+        self.runner.run(["gsutil", "-m", "cp", "-r", local, gcs_uri])
+
+    def download(self, gcs_uri: str, local: str) -> None:
+        if not gcs_uri.startswith("gs://"):
+            raise ValueError(f"not a GCS uri: {gcs_uri}")
+        self.runner.run(["gsutil", "-m", "cp", "-r", gcs_uri, local])
+
+
+class ClusterSetup:
+    """Provision a slice, push the training package, launch the distributed
+    run on every worker (reference: ec2/provision/ClusterSetup.java +
+    DistributedDeepLearningTrainer.java — whose flow is: create boxes,
+    provision each over SSH, start the distributed job).
+
+    On TPU pods the 'cluster' is one named slice whose workers already
+    share ICI; the launch step runs the SAME command on every worker and
+    jax.distributed derives rank/coordinator from the TPU metadata server,
+    so no hand-rolled coordinator bootstrap is needed.
+    """
+
+    def __init__(self, project: str, zone: str, dry_run: bool = False):
+        self.runner = CommandRunner(dry_run=dry_run)
+        self.tpus = TpuVmProvisioner(project, zone, self.runner)
+        self.gcs = GcsTransfer(self.runner)
+
+    def provision(self, name: str, accelerator_type: str = "v5litepod-16",
+                  version: str = "v2-alpha-tpuv5-lite",
+                  package_path: Optional[str] = None,
+                  pip_spec: str = "deeplearning4j_tpu") -> None:
+        self.tpus.create(name, accelerator_type, version)
+        self.tpus.wait_until_ready(name)
+        if package_path is not None:
+            self.tpus.scp(name, package_path, "~/pkg/")
+            self.tpus.ssh(name, "pip install ~/pkg/*")
+        else:
+            self.tpus.ssh(name, f"pip install {pip_spec}")
+
+    def launch(self, name: str, train_command: str) -> str:
+        """Start ``train_command`` on all workers simultaneously — the
+        ClusterSetup.java 'distributed launch' step."""
+        return self.tpus.ssh(name, train_command, worker="all")
+
+    def teardown(self, name: str) -> None:
+        self.tpus.delete(name)

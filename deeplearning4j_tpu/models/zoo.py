@@ -293,7 +293,15 @@ class ResNet50(ZooModel):
     """ResNet-50 as a ComputationGraph (reference: zoo/model/ResNet50.java:
     33,82 graphBuilder, :91-125 identityBlock, :128-172 convBlock). The
     residual blocks are ElementWiseVertex(add) joins — on TPU the whole graph
-    is one XLA program; BN+ReLU fuse into the convolutions."""
+    is one XLA program; BN+ReLU fuse into the convolutions.
+
+    Note: the reference's fan-in-independent N(0, 0.5) weight init
+    (ResNet50.java:178-179, reproduced below) makes the UNTRAINED network's
+    eval-mode forward overflow float32 (~24x activation growth per conv
+    through 50 layers; BN running stats are identity at init). This matches
+    the reference; training is finite from step one because train-mode BN
+    normalizes with batch statistics. Use ``weight_init("relu")`` on a
+    custom build if you need sane eval-mode activations at init."""
 
     input_shape = (224, 224, 3)
 

@@ -36,3 +36,11 @@ from deeplearning4j_tpu.parallel.early_stopping import (
     EarlyStoppingParallelTrainer,
 )
 from deeplearning4j_tpu.parallel.pipeline import PipelineTrainer
+from deeplearning4j_tpu.parallel.elastic import (
+    CheckpointListener,
+    CheckpointStore,
+    FailureDetector,
+    FaultInjectionListener,
+    FaultTolerantTrainer,
+    Heartbeat,
+)

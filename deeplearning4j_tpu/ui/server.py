@@ -14,6 +14,9 @@ inline-JS overview page (no external dependencies):
 - GET /                             -> HTML overview (score chart via canvas)
 - GET /model /system /histograms    -> HTML pages over the JSON endpoints
   (the TrainModule model/system/histogram tabs of deeplearning4j-play)
+- POST /tsne/upload?sid=...         -> store 2-D embedding coords (+labels)
+- GET /tsne/coords?sid=... /tsne    -> coords JSON / scatter page
+  (the TsneModule of deeplearning4j-play, fed by plot.Tsne results)
 """
 
 from __future__ import annotations
@@ -66,7 +69,7 @@ refresh();setInterval(refresh,2000);
 
 _NAV = ('<p><a href="/">overview</a> | <a href="/model">model</a> | '
         '<a href="/system">system</a> | <a href="/histograms">histograms</a>'
-        '</p>')
+        ' | <a href="/tsne">tsne</a></p>')
 
 _MODEL_PAGE = """<!doctype html><html><head><title>model</title>
 <style>body{font-family:sans-serif;margin:2em}
@@ -147,6 +150,40 @@ refresh();setInterval(refresh,3000);
 </script></body></html>"""
 
 
+_TSNE_PAGE = """<!doctype html><html><head><title>tsne</title>
+<style>body{font-family:sans-serif;margin:2em}canvas{border:1px solid #ccc}
+</style></head><body>""" + _NAV + """
+<h2>t-SNE embedding</h2><div id="meta"></div>
+<canvas id="plot" width="700" height="700"></canvas>
+<script>
+async function refresh(){
+ const sids=await (await fetch('/tsne/sessions')).json();
+ if(!sids.length)return;
+ const sid=sids[sids.length-1];
+ const d=await (await fetch('/tsne/coords?sid='+sid)).json();
+ const pts=d.points||[];
+ document.getElementById('meta').textContent=
+   'session '+sid+' — '+pts.length+' points';
+ if(!pts.length)return;
+ const c=document.getElementById('plot').getContext('2d');
+ c.clearRect(0,0,700,700);
+ const xs=pts.map(p=>p[0]),ys=pts.map(p=>p[1]);
+ const x0=Math.min(...xs),x1=Math.max(...xs),
+       y0=Math.min(...ys),y1=Math.max(...ys);
+ const colors=['#06c','#c60','#090','#909','#a00','#0aa','#660','#555'];
+ const groups={};(d.labels||[]).forEach((l,i)=>{groups[l]=groups[l]??
+   Object.keys(groups).length;});
+ pts.forEach((p,i)=>{
+  const px=20+(p[0]-x0)/((x1-x0)||1)*660;
+  const py=680-(p[1]-y0)/((y1-y0)||1)*660;
+  c.fillStyle=colors[(groups[(d.labels||[])[i]]||0)%colors.length];
+  c.beginPath();c.arc(px,py,2.5,0,6.3);c.fill();
+  if(pts.length<=200&&d.labels)c.fillText(d.labels[i],px+4,py);});
+}
+refresh();setInterval(refresh,5000);
+</script></body></html>"""
+
+
 class UIServer:
     """Singleton-ish server (reference: UIServer.getInstance())."""
 
@@ -161,6 +198,7 @@ class UIServer:
     def __init__(self, port: int = 0):
         self.storages: list = []
         self._remote_sink = InMemoryStatsStorage()
+        self._tsne: dict = {}  # session id -> {"points": ..., "labels": ...}
         self._httpd = None
         self._thread = None
         self._port = port
@@ -212,11 +250,16 @@ class UIServer:
                 sid = q.get("sid", [None])[0]
                 pages = {"/": _PAGE, "/model": _MODEL_PAGE,
                          "/system": _SYSTEM_PAGE,
-                         "/histograms": _HISTOGRAM_PAGE}
+                         "/histograms": _HISTOGRAM_PAGE,
+                         "/tsne": _TSNE_PAGE}
                 if u.path in pages:
                     self._html(pages[u.path])
                 elif u.path == "/train/sessions":
                     self._json(server.list_sessions())
+                elif u.path == "/tsne/sessions":
+                    self._json(sorted(server._tsne))
+                elif u.path == "/tsne/coords":
+                    self._json(server._tsne.get(sid, {}))
                 elif u.path == "/train/overview":
                     self._json(server.overview(sid))
                 elif u.path == "/train/model":
@@ -229,7 +272,16 @@ class UIServer:
                     self._json({"error": "not found"}, 404)
 
             def do_POST(self):
-                if urlparse(self.path).path != "/remoteReceive":
+                u = urlparse(self.path)
+                if u.path == "/tsne/upload":
+                    sid = parse_qs(u.query).get("sid", ["default"])[0]
+                    n = int(self.headers.get("Content-Length", 0))
+                    msg = json.loads(self.rfile.read(n))
+                    server.upload_tsne(sid, msg.get("points", []),
+                                       msg.get("labels"))
+                    self._json({"status": "ok"})
+                    return
+                if u.path != "/remoteReceive":
                     self._json({"error": "not found"}, 404)
                     return
                 n = int(self.headers.get("Content-Length", 0))
@@ -291,6 +343,18 @@ class UIServer:
                 ips.append(r["data"].get("iterations_per_second"))
         return {"iterations": iters, "memory_mb": mem,
                 "iterations_per_second": ips}
+
+    def upload_tsne(self, session_id, points, labels=None) -> None:
+        """Store a 2-D embedding for the /tsne page (reference: TsneModule
+        of deeplearning4j-play, which accepts uploaded coordinate files).
+        ``points``: [N,2] array-like; ``labels``: optional length-N list.
+        Typical source: ``plot.Tsne(...).fit(vectors)``."""
+        pts = [[float(p[0]), float(p[1])] for p in points]
+        self._tsne[str(session_id)] = {
+            "points": pts,
+            "labels": [str(l) for l in labels] if labels is not None
+            else None,
+        }
 
     def histograms(self, session_id) -> dict:
         """Latest collected parameter histograms (reference: TrainModule

@@ -1,0 +1,80 @@
+"""Cloud provisioning command layer (cloud/provision.py) — the
+deeplearning4j-aws analog. Tests run entirely in dry-run mode: they assert
+the exact gcloud/gsutil argv the module would execute."""
+
+import pytest
+
+from deeplearning4j_tpu.cloud import ClusterSetup, GcsTransfer, TpuVmProvisioner
+from deeplearning4j_tpu.cloud.provision import CommandRunner
+
+
+class TestTpuVmProvisioner:
+    def test_create_describe_delete_argv(self):
+        r = CommandRunner(dry_run=True)
+        tpus = TpuVmProvisioner("my-proj", "us-central1-a", r)
+        tpus.create("pod1", accelerator_type="v5litepod-8", preemptible=True)
+        tpus.describe("pod1")
+        tpus.delete("pod1")
+        create, describe, delete = r.history
+        assert create[:5] == ["gcloud", "compute", "tpus", "tpu-vm",
+                              "create"]
+        assert "pod1" in create
+        assert "--accelerator-type=v5litepod-8" in create
+        assert "--preemptible" in create
+        assert "--project=my-proj" in create and \
+               "--zone=us-central1-a" in create
+        assert "describe" in describe and "delete" in delete
+
+    def test_wait_until_ready_polls_state(self):
+        r = CommandRunner(dry_run=True)
+        r.canned[("gcloud", "compute", "tpus", "tpu-vm", "describe")] = \
+            "READY\n"
+        tpus = TpuVmProvisioner("p", "z", r)
+        tpus.wait_until_ready("pod1")
+        assert any("describe" in argv for argv in r.history)
+
+    def test_ssh_and_scp_target_all_workers(self):
+        r = CommandRunner(dry_run=True)
+        tpus = TpuVmProvisioner("p", "z", r)
+        tpus.ssh("pod1", "hostname")
+        tpus.scp("pod1", "wheel.whl", "~/wheel.whl")
+        ssh, scp = r.history
+        assert "--worker=all" in ssh and "--command=hostname" in ssh
+        assert "pod1:~/wheel.whl" in scp
+
+
+class TestGcsTransfer:
+    def test_upload_download_argv_and_uri_validation(self):
+        r = CommandRunner(dry_run=True)
+        gcs = GcsTransfer(r)
+        gcs.upload("model.zip", "gs://bucket/model.zip")
+        gcs.download("gs://bucket/data", "data/")
+        up, down = r.history
+        assert up == ["gsutil", "-m", "cp", "-r", "model.zip",
+                      "gs://bucket/model.zip"]
+        assert down[-2:] == ["gs://bucket/data", "data/"]
+        with pytest.raises(ValueError):
+            gcs.upload("x", "s3://nope")
+
+
+class TestClusterSetup:
+    def test_full_flow_records_a_runnable_script(self):
+        cs = ClusterSetup("my-proj", "us-central1-a", dry_run=True)
+        cs.provision("train-pod", package_path="dist/pkg.whl")
+        cs.launch("train-pod", "python -m train --epochs 10")
+        cs.teardown("train-pod")
+        script = cs.runner.script()
+        # ordered: create -> describe(wait) -> scp -> pip -> launch -> delete
+        order = [script.index(tok) for tok in
+                 ("create", "describe", "scp", "pip install",
+                  "python -m train", "delete")]
+        assert order == sorted(order), script
+        # every line is a real gcloud/gsutil invocation
+        assert all(line.startswith(("gcloud ", "gsutil "))
+                   for line in script.splitlines())
+
+    def test_pip_spec_install_when_no_package(self):
+        cs = ClusterSetup("p", "z", dry_run=True)
+        cs.provision("pod", pip_spec="deeplearning4j_tpu==1.0")
+        assert any("pip install deeplearning4j_tpu==1.0" in " ".join(argv)
+                   for argv in cs.runner.history)

@@ -186,3 +186,45 @@ class TestUIServer:
             assert sum(hist["param_histograms"]["0/W"]["counts"]) == 12
         finally:
             server.stop()
+
+    def test_tsne_module_upload_and_page(self):
+        """TsneModule analog: coords uploaded (HTTP or in-process) render on
+        the /tsne page (reference: deeplearning4j-play TsneModule)."""
+        server = UIServer(port=0)
+        port = server.start()
+        try:
+            base = f"http://127.0.0.1:{port}"
+            body = json.dumps({
+                "points": [[0.0, 0.0], [1.0, 2.0], [-1.5, 0.5]],
+                "labels": ["a", "b", "c"],
+            }).encode()
+            req = urllib.request.Request(
+                base + "/tsne/upload?sid=emb", data=body,
+                headers={"Content-Type": "application/json"})
+            assert json.loads(urllib.request.urlopen(req).read())[
+                "status"] == "ok"
+            sessions = json.loads(urllib.request.urlopen(
+                base + "/tsne/sessions").read())
+            assert sessions == ["emb"]
+            coords = json.loads(urllib.request.urlopen(
+                base + "/tsne/coords?sid=emb").read())
+            assert coords["points"][1] == [1.0, 2.0]
+            assert coords["labels"] == ["a", "b", "c"]
+            page = urllib.request.urlopen(base + "/tsne").read().decode()
+            assert "t-SNE embedding" in page
+        finally:
+            server.stop()
+
+    def test_tsne_from_plot_module(self):
+        """End-to-end: plot.Tsne output feeds upload_tsne directly."""
+        from deeplearning4j_tpu.plot import Tsne
+
+        rs = np.random.RandomState(0)
+        x = np.concatenate([rs.randn(10, 8) + 4, rs.randn(10, 8) - 4])
+        coords = np.asarray(Tsne(max_iter=30, perplexity=5.0,
+                                 seed=3).fit(x))
+        server = UIServer(port=0)
+        server.upload_tsne("w2v", coords, labels=[str(i) for i in range(20)])
+        stored = server._tsne["w2v"]
+        assert len(stored["points"]) == 20
+        assert all(len(p) == 2 for p in stored["points"])
