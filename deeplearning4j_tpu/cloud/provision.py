@@ -156,6 +156,7 @@ class ClusterSetup:
         self.tpus.create(name, accelerator_type, version)
         self.tpus.wait_until_ready(name)
         if package_path is not None:
+            self.tpus.ssh(name, "mkdir -p ~/pkg")
             self.tpus.scp(name, package_path, "~/pkg/")
             self.tpus.ssh(name, "pip install ~/pkg/*")
         else:

@@ -62,17 +62,11 @@ class ComputationGraph:
         order = self.conf.topo_order
         keys = jax.random.split(rng, max(len(order), 1))
         if params is None:
-            # fused single-program init on TPU only (see
-            # MultiLayerNetwork.init): 33 separate compiles + remote
-            # dispatches measured 84 s of ResNet50 startup through the
-            # tunnel; on CPU eager per-op caching wins
-            def _init_all(ks):
-                return {name: self.conf.vertices[name].init_params(ks[i], dtype)
-                        for i, name in enumerate(order)}
+            from deeplearning4j_tpu.utils.pytree import run_fused_on_tpu
 
-            if jax.default_backend() == "tpu":
-                _init_all = jax.jit(_init_all)
-            self.params = _init_all(keys)
+            self.params = run_fused_on_tpu(
+                lambda ks: {name: self.conf.vertices[name].init_params(
+                    ks[i], dtype) for i, name in enumerate(order)}, keys)
         else:
             self.params = params
         self.state = {name: self.conf.vertices[name].init_state(dtype)

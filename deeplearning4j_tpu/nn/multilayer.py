@@ -68,19 +68,11 @@ class MultiLayerNetwork:
         rng = jax.random.PRNGKey(self.conf.seed)
         keys = jax.random.split(rng, max(len(self.layers), 1))
         if params is None:
-            # On TPU, fuse the whole net's sampling into one program:
-            # per-layer eager init costs one XLA compile + one remote
-            # dispatch per distinct shape (measured 84 s of ResNet50 startup
-            # through the TPU tunnel, profiles/README.md). On CPU the eager
-            # path wins (tiny per-op programs are cached across
-            # architectures; a fused per-architecture compile is not).
-            def _init_all(ks):
-                return {str(i): l.init_params(ks[i], dtype)
-                        for i, l in enumerate(self.layers)}
+            from deeplearning4j_tpu.utils.pytree import run_fused_on_tpu
 
-            if jax.default_backend() == "tpu":
-                _init_all = jax.jit(_init_all)
-            self.params = _init_all(keys)
+            self.params = run_fused_on_tpu(
+                lambda ks: {str(i): l.init_params(ks[i], dtype)
+                            for i, l in enumerate(self.layers)}, keys)
         else:
             self.params = params
         self.state = {str(i): l.init_state(dtype) for i, l in enumerate(self.layers)}

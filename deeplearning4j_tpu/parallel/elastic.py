@@ -58,6 +58,14 @@ class CheckpointStore:
         self.directory = directory
         self.keep = keep
         os.makedirs(directory, exist_ok=True)
+        # sweep orphan temp files from saves killed between mkstemp and
+        # the atomic rename (the exact crash window this store exists for)
+        for name in os.listdir(directory):
+            if name.endswith(".tmp"):
+                try:
+                    os.unlink(os.path.join(directory, name))
+                except OSError:
+                    pass
 
     # ------------------------------------------------------------- paths
     def _path(self, iteration: int) -> str:
@@ -209,7 +217,16 @@ class FaultTolerantTrainer:
     def run(self, iterator_factory: Callable[[], object], epochs: int):
         """Resume from the newest checkpoint if one exists, else start
         fresh. Returns the trained network (which replaces ``self.net`` on
-        resume)."""
+        resume).
+
+        Checkpoints written by this trainer carry the exact (epoch,
+        batch_in_epoch) position. A checkpoint without it (e.g. written by
+        a bare CheckpointListener with no ``meta_fn``) would otherwise
+        silently re-train every completed batch on top of the restored
+        weights; instead the position is derived from the restored
+        iteration counter and the stream length (one counting pass over a
+        fresh iterator — cheap, and the factory contract already promises
+        a repeatable stream)."""
         restored = self.store.restore()
         if restored is None:
             return self.fit(iterator_factory, epochs)
@@ -217,11 +234,23 @@ class FaultTolerantTrainer:
         if meta.get("complete"):
             self.net = net
             return net
+        if "epoch" in meta and "batch_in_epoch" in meta:
+            start_epoch = meta["epoch"]
+            skip = meta["batch_in_epoch"]
+        else:
+            per_epoch = sum(1 for _ in iterator_factory())
+            if per_epoch == 0:
+                raise ValueError("iterator_factory produced an empty stream")
+            start_epoch = net.iteration // per_epoch
+            skip = net.iteration % per_epoch
+            warnings.warn(
+                "checkpoint has no elastic position metadata; derived "
+                f"resume point epoch={start_epoch} batch={skip} from "
+                f"iteration={net.iteration} and stream length {per_epoch}")
         net.listeners = self.net.listeners
         self.net = net
         return self.fit(iterator_factory, epochs,
-                        start_epoch=meta.get("epoch", 0),
-                        skip_batches=meta.get("batch_in_epoch", 0))
+                        start_epoch=start_epoch, skip_batches=skip)
 
 
 class Heartbeat:

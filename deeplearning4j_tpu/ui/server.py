@@ -276,9 +276,14 @@ class UIServer:
                 if u.path == "/tsne/upload":
                     sid = parse_qs(u.query).get("sid", ["default"])[0]
                     n = int(self.headers.get("Content-Length", 0))
-                    msg = json.loads(self.rfile.read(n))
-                    server.upload_tsne(sid, msg.get("points", []),
-                                       msg.get("labels"))
+                    try:
+                        msg = json.loads(self.rfile.read(n))
+                        server.upload_tsne(sid, msg.get("points", []),
+                                           msg.get("labels"))
+                    except (ValueError, TypeError, IndexError,
+                            KeyError) as e:
+                        self._json({"error": f"bad payload: {e}"}, 400)
+                        return
                     self._json({"status": "ok"})
                     return
                 if u.path != "/remoteReceive":
@@ -344,17 +349,28 @@ class UIServer:
         return {"iterations": iters, "memory_mb": mem,
                 "iterations_per_second": ips}
 
+    # bounds for HTTP-uploaded embeddings: the UI port is reachable by any
+    # local process, so memory growth must be capped (oldest session is
+    # evicted, matching the rolling character of the stats storages)
+    TSNE_MAX_POINTS = 200_000
+    TSNE_MAX_SESSIONS = 32
+
     def upload_tsne(self, session_id, points, labels=None) -> None:
         """Store a 2-D embedding for the /tsne page (reference: TsneModule
         of deeplearning4j-play, which accepts uploaded coordinate files).
         ``points``: [N,2] array-like; ``labels``: optional length-N list.
         Typical source: ``plot.Tsne(...).fit(vectors)``."""
+        if len(points) > self.TSNE_MAX_POINTS:
+            raise ValueError(
+                f"too many points ({len(points)} > {self.TSNE_MAX_POINTS})")
         pts = [[float(p[0]), float(p[1])] for p in points]
         self._tsne[str(session_id)] = {
             "points": pts,
             "labels": [str(l) for l in labels] if labels is not None
             else None,
         }
+        while len(self._tsne) > self.TSNE_MAX_SESSIONS:
+            self._tsne.pop(next(iter(self._tsne)))
 
     def histograms(self, session_id) -> dict:
         """Latest collected parameter histograms (reference: TrainModule

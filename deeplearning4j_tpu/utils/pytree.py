@@ -49,3 +49,19 @@ def unflatten_params(flat, params_template: dict, layers=None) -> dict:
     if off != flat.size:
         raise ValueError(f"Flat param size {flat.size} != expected {off}")
     return out
+
+
+def run_fused_on_tpu(fn, *args):
+    """Run ``fn(*args)`` jitted on TPU, eagerly elsewhere.
+
+    Network param init is the user: per-layer eager sampling costs one XLA
+    compile + one remote dispatch per distinct shape (84 s of ResNet50
+    startup through the TPU tunnel, profiles/README.md), while one fused
+    program compiles once; on CPU the relation inverts (tiny per-op
+    programs are cached across architectures, a fused per-architecture
+    compile is not). Values are bitwise identical either way."""
+    import jax
+
+    if jax.default_backend() == "tpu":
+        fn = jax.jit(fn)
+    return fn(*args)

@@ -264,3 +264,35 @@ class TestFailureDetection:
         det = FailureDetector(str(tmp_path), timeout=5.0)
         assert det.dead_workers() == []
         assert det.dead_workers(now=time.time() + 30) == ["w"]
+
+
+class TestDerivedResume:
+    def test_bare_listener_checkpoint_resumes_without_double_training(
+            self, tmp_path):
+        """A checkpoint written by a bare CheckpointListener (no meta_fn)
+        has no position metadata; run() must derive the resume point from
+        the iteration counter instead of silently re-training."""
+        batches = _batches(6)
+        factory = lambda: ListDataSetIterator(list(batches), batch_size=16)
+
+        base = _net()
+        for _ in range(2):
+            for ds in batches:
+                base._fit_batch(ds)
+
+        # crash after iteration 8 (epoch 1, batch 2); checkpoints at 4, 8
+        # come from a plain listener attached to an ordinary fit loop
+        store = CheckpointStore(str(tmp_path), keep=5)
+        net = _net()
+        net.set_listeners(CheckpointListener(store, frequency=4),
+                          FaultInjectionListener(at_iteration=8))
+        with pytest.raises(FaultInjectionListener.InjectedFault):
+            net.fit(factory(), epochs=2)
+
+        resumed = FaultTolerantTrainer(_net(seed=3), store, frequency=4)
+        with pytest.warns(UserWarning, match="derived resume point"):
+            final = resumed.run(factory, epochs=2)
+        assert final.iteration == base.iteration
+        np.testing.assert_allclose(
+            np.asarray(final.params_flat(), np.float32),
+            np.asarray(base.params_flat(), np.float32), rtol=0, atol=0)
