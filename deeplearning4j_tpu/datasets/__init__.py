@@ -9,6 +9,7 @@ from deeplearning4j_tpu.datasets.iterators import (
     DataSetIterator,
     ListDataSetIterator,
     AsyncDataSetIterator,
+    DevicePrefetchIterator,
     MultipleEpochsIterator,
 )
 from deeplearning4j_tpu.datasets.streaming import (

@@ -24,6 +24,19 @@ class DataSet:
     def num_examples(self) -> int:
         return int(self.features.shape[0])
 
+    @classmethod
+    def on_device(cls, features, labels=None, features_mask=None,
+                  labels_mask=None) -> "DataSet":
+        """Build a DataSet around already-placed jax arrays WITHOUT the
+        base __init__'s np.asarray (which would pull them back to host).
+        Used by device-prefetch and mesh-placement iterators."""
+        ds = cls.__new__(cls)
+        ds.features = features
+        ds.labels = labels
+        ds.features_mask = features_mask
+        ds.labels_mask = labels_mask
+        return ds
+
     def split_test_and_train(self, n_train: int):
         def cut(a, sl):
             return None if a is None else a[sl]

@@ -109,6 +109,90 @@ class AsyncDataSetIterator(DataSetIterator):
         return self.base.total_examples() if hasattr(self.base, "total_examples") else None
 
 
+class DevicePrefetchIterator(DataSetIterator):
+    """Keeps the next ``depth`` minibatches already ON DEVICE while the
+    current one trains — the TPU-native second half of async prefetch.
+
+    ``AsyncDataSetIterator`` overlaps host-side batch PRODUCTION with
+    compute; this overlaps the host->device TRANSFER too. ``jax.device_put``
+    dispatches asynchronously, so simply issuing the puts ``depth`` batches
+    ahead pipelines the copies behind the running step — no extra thread
+    needed (the flax ``prefetch_to_device`` pattern, expressed over the
+    DataSetIterator contract; reference analog: AsyncDataSetIterator,
+    datasets/iterator/AsyncDataSetIterator.java:30). The whole batch goes
+    up as ONE ``device_put`` pytree call (one dispatch, not four).
+
+    Measured caveat: the win depends on the backend's transfer path being
+    the bottleneck. On a locally attached TPU this is the standard input
+    pipeline; through the oversubscribed remote tunnel used for CI
+    measurements, results swing with far-side contention (0.3x-1.3x
+    observed within minutes of each other) — benchmark your own setup.
+
+    ``sharding`` (optional ``jax.sharding.Sharding``) places each batch for
+    mesh training — compose with ``ParallelWrapper``/``ShardedTrainer``
+    data shardings.
+    """
+
+    def __init__(self, base: Iterable, depth: int = 2, sharding=None):
+        if depth < 1:
+            raise ValueError("depth must be >= 1")
+        self.base = base
+        self.depth = depth
+        self.sharding = sharding
+
+    def reset(self):
+        if hasattr(self.base, "reset"):
+            self.base.reset()
+
+    def _put(self, ds):
+        import jax
+
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        # ONE device_put over the whole batch pytree: a remote PJRT backend
+        # pays per-dispatch latency, so 1 transfer call per batch beats 4
+        arrs = tuple(None if a is None else np.asarray(a)
+                     for a in (ds.features, ds.labels, ds.features_mask,
+                               ds.labels_mask))
+        if self.sharding is not None:
+            # fail with a clear message on a trailing partial batch the
+            # mesh cannot split — the raw jax error would surface `depth`
+            # batches away from the offending data
+            try:
+                self.sharding.shard_shape(np.shape(arrs[0]))
+            except ValueError as e:
+                raise ValueError(
+                    f"batch shape {np.shape(arrs[0])} is not divisible "
+                    f"onto sharding {self.sharding} (trailing partial "
+                    "batch? drop it or pad before prefetching)") from e
+        placed = (jax.device_put(arrs, self.sharding)
+                  if self.sharding is not None else jax.device_put(arrs))
+        return DataSet.on_device(*placed)
+
+    def _iterate(self):
+        from collections import deque
+
+        it = (self.base._iterate() if isinstance(self.base, DataSetIterator)
+              else iter(self.base))
+        buf: deque = deque()
+        try:
+            for _ in range(self.depth):
+                buf.append(self._put(next(it)))
+        except StopIteration:
+            pass
+        while buf:
+            nxt = buf.popleft()
+            try:
+                buf.append(self._put(next(it)))  # dispatch ahead, async
+            except StopIteration:
+                pass
+            yield nxt
+
+    def total_examples(self):
+        return self.base.total_examples() \
+            if hasattr(self.base, "total_examples") else None
+
+
 class MultipleEpochsIterator(DataSetIterator):
     """Replays a base iterator N times as one pass (reference:
     datasets/iterator/MultipleEpochsIterator.java)."""

@@ -54,16 +54,24 @@ class CheckpointStore:
     ``*.corrupt`` and skipped.
     """
 
+    TMP_SWEEP_AGE_S = 600  # orphan .tmp older than this is crash debris
+
     def __init__(self, directory: str, keep: int = 3):
         self.directory = directory
         self.keep = keep
         os.makedirs(directory, exist_ok=True)
-        # sweep orphan temp files from saves killed between mkstemp and
-        # the atomic rename (the exact crash window this store exists for)
+        # sweep orphan temp files from saves killed between mkstemp and the
+        # atomic rename (the exact crash window this store exists for) —
+        # but only STALE ones: another live writer sharing the directory
+        # finishes its save in seconds, so an age gate keeps the sweep from
+        # unlinking an in-flight file under it
+        now = time.time()
         for name in os.listdir(directory):
             if name.endswith(".tmp"):
+                path = os.path.join(directory, name)
                 try:
-                    os.unlink(os.path.join(directory, name))
+                    if now - os.path.getmtime(path) > self.TMP_SWEEP_AGE_S:
+                        os.unlink(path)
                 except OSError:
                     pass
 

@@ -115,19 +115,6 @@ def shard_network(net, mesh: Mesh) -> dict:
     return pspecs
 
 
-class _PlacedDataSet(DataSet):
-    """DataSet holding already-placed (sharded) jax arrays — the base
-    __init__'s np.asarray would pull them back to host, so it is bypassed.
-    Being a DataSet subclass keeps isinstance routing in net.fit working."""
-
-    def __init__(self, features, labels, features_mask=None,
-                 labels_mask=None):
-        self.features = features
-        self.labels = labels
-        self.features_mask = features_mask
-        self.labels_mask = labels_mask
-
-
 class _PlacedIterator:
     """Wraps a DataSetIterator, yielding mesh-placed batches."""
 
@@ -177,7 +164,7 @@ class ShardedTrainer:
                 continue
             a = np.asarray(a)
             out.append(jax.device_put(a, data_batch_sharding(self.mesh, a)))
-        return _PlacedDataSet(*out)
+        return DataSet.on_device(*out)
 
     def fit(self, iterator, epochs: int = 1):
         """Delegates to the net's own fit (listeners, epochs, TBPTT routing

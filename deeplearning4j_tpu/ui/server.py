@@ -275,8 +275,8 @@ class UIServer:
                 u = urlparse(self.path)
                 if u.path == "/tsne/upload":
                     sid = parse_qs(u.query).get("sid", ["default"])[0]
-                    n = int(self.headers.get("Content-Length", 0))
                     try:
+                        n = int(self.headers.get("Content-Length", 0))
                         msg = json.loads(self.rfile.read(n))
                         server.upload_tsne(sid, msg.get("points", []),
                                            msg.get("labels"))
@@ -363,6 +363,9 @@ class UIServer:
         if len(points) > self.TSNE_MAX_POINTS:
             raise ValueError(
                 f"too many points ({len(points)} > {self.TSNE_MAX_POINTS})")
+        if labels is not None and len(labels) != len(points):
+            raise ValueError(
+                f"labels length {len(labels)} != points length {len(points)}")
         pts = [[float(p[0]), float(p[1])] for p in points]
         self._tsne[str(session_id)] = {
             "points": pts,
