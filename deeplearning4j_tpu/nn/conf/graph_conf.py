@@ -571,7 +571,12 @@ class GraphBuilder:
             v.finalize(self._g)
             in_types = [types.get(i) for i in vertex_inputs[name]]
             if any(t is None for t in in_types):
-                continue  # no input types declared; skip inference
+                # no input types declared: shape inference is impossible,
+                # but config sanity (n_in/n_out, conv geometry) must still
+                # run — the MLN path validates unconditionally
+                if isinstance(v, LayerVertex):
+                    v.layer.validate()
+                continue
             if isinstance(v, LayerVertex):
                 it = in_types[0]
                 if v.preprocessor is None:

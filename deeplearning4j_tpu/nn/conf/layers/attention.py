@@ -70,6 +70,7 @@ class SelfAttentionLayer(BaseLayer):
             self.n_out = self.n_in
 
     def validate(self) -> None:
+        super().validate()
         if self.n_out % self.n_heads:
             raise ValueError(f"n_out={self.n_out} not divisible by "
                              f"n_heads={self.n_heads}")

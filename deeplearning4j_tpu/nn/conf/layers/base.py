@@ -76,7 +76,25 @@ class Layer:
         FeedForwardLayer.setNIn auto-config)."""
 
     def validate(self) -> None:
-        pass
+        """Config sanity, run at build() time so bad configs fail with a
+        named-layer message instead of a raw XLA shape error at fit time
+        (reference: the checks behind exceptions/TestInvalidConfigurations
+        — nIn/nOut == 0 raise at init, DL4JInvalidConfigException).
+
+        Only WEIGHTED layers need n_in/n_out: paramless passthroughs
+        (LastTimeStep, ActivationLayer, ...) inherit the fields without
+        consuming them."""
+        if not self.param_order():
+            return
+        for attr in ("n_in", "n_out"):
+            v = getattr(self, attr, None)
+            if isinstance(v, int) and v <= 0:
+                label = self.name or type(self).__name__
+                hint = (" (set an InputType on the builder, or pass "
+                        f"{attr} explicitly)" if attr == "n_in" else "")
+                raise ValueError(
+                    f"Invalid configuration for layer '{label}': "
+                    f"{attr} must be > 0, got {v}{hint}")
 
     # ---- params ----------------------------------------------------------------
     def param_order(self) -> list[str]:

@@ -36,11 +36,41 @@ def conv_out_size(size: int, k: int, s: int, p: int, mode: str) -> int:
     if mode == "same":
         return int(math.ceil(size / s))
     out = (size - k + 2 * p) // s + 1
+    if out <= 0:
+        # reference: ConvolutionUtils.getOutputSize throws
+        # DL4JInvalidInputException for input smaller than the kernel
+        raise ValueError(
+            f"Invalid configuration or input: input size {size} with "
+            f"kernel {k}, stride {s}, padding {p} gives non-positive "
+            f"output size {out} — input is smaller than the (padded) "
+            "kernel")
     if mode == "strict" and (size - k + 2 * p) % s != 0:
         raise ValueError(
             f"ConvolutionMode.Strict: (in={size} - k={k} + 2*p={p}) not divisible by "
             f"stride {s}; use mode='truncate' or 'same'")
     return out
+
+
+def validate_conv_geometry(layer, kind: str) -> None:
+    """Kernel/stride/padding sanity shared by conv and pooling configs
+    (reference: ConvolutionUtils + the invalid kernel/stride/padding cases
+    of exceptions/TestInvalidConfigurations.java:337-380)."""
+    label = getattr(layer, "name", None) or type(layer).__name__
+    kh, kw = layer.kernel_size
+    sh, sw = layer.stride
+    ph, pw = layer.padding
+    if kh <= 0 or kw <= 0:
+        raise ValueError(f"Invalid {kind} configuration for layer "
+                         f"'{label}': kernel {layer.kernel_size} must be "
+                         "positive")
+    if sh <= 0 or sw <= 0:
+        raise ValueError(f"Invalid {kind} configuration for layer "
+                         f"'{label}': stride {layer.stride} must be "
+                         "positive")
+    if ph < 0 or pw < 0:
+        raise ValueError(f"Invalid {kind} configuration for layer "
+                         f"'{label}': padding {layer.padding} must be "
+                         "non-negative")
 
 
 def _conv_padding(mode: str, pad):
@@ -71,6 +101,10 @@ class ConvolutionLayer(BaseLayer):
         self.stride = _pair(self.stride)
         self.padding = _pair(self.padding)
         self.dilation = _pair(self.dilation)
+
+    def validate(self) -> None:
+        validate_conv_geometry(self, "convolution")
+        super().validate()
 
     def set_n_in(self, input_type: InputType) -> None:
         if self.n_in == 0:
@@ -249,6 +283,10 @@ class SubsamplingLayer(Layer):
         self.kernel_size = _pair(self.kernel_size)
         self.stride = _pair(self.stride)
         self.padding = _pair(self.padding)
+
+    def validate(self) -> None:
+        validate_conv_geometry(self, "subsampling")
+        super().validate()
 
     def output_type(self, input_type: InputType) -> InputType:
         kh, kw = self.kernel_size

@@ -36,6 +36,9 @@ class FrozenLayer(Layer):
     def param_order(self):
         return self.inner.param_order()
 
+    def validate(self) -> None:
+        self.inner.validate()
+
     def init_params(self, rng, dtype=jnp.float32):
         return self.inner.init_params(rng, dtype)
 

@@ -55,6 +55,7 @@ class RBM(FeedForwardLayer):
     sparsity: float = 0.0
 
     def validate(self) -> None:
+        super().validate()
         if self.hidden_unit not in _HIDDEN_UNITS:
             raise ValueError(f"hidden_unit must be one of {_HIDDEN_UNITS}, "
                              f"got '{self.hidden_unit}'")

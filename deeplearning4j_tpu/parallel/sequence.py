@@ -85,14 +85,65 @@ def ring_attention(q, k, v, *, mesh: Mesh, axis: str = SEQ_AXIS,
     return fn(q, k, v)
 
 
+def ulysses_attention(q, k, v, *, mesh: Mesh, axis: str = SEQ_AXIS,
+                      causal: bool = False):
+    """All-to-all (DeepSpeed-Ulysses style) sequence parallelism.
+
+    Where ring attention keeps the TIME axis sharded and rotates K/V blocks
+    ``n`` times around the ring, this re-shards with two collectives: an
+    ``all_to_all`` turns the layout from sequence-sharded [B, H, T/n, d]
+    into HEAD-sharded [B, H/n, T, d], each device runs ordinary
+    full-sequence attention for its own heads, and a second ``all_to_all``
+    restores sequence sharding. Communication is 2 all-to-alls per tensor
+    (vs n ppermute rounds) — the better trade when heads >= devices and the
+    per-device time block is small; ring wins when T is huge and H is
+    small (Jacobs et al. 2023, DeepSpeed-Ulysses). Requires H divisible by
+    the axis size. Differentiable (all_to_all has a transpose rule), so
+    training works through it unchanged.
+
+    q/k/v: [B, H, T, d] global arrays; returns [B, H, T, d], numerically
+    equal to single-device softmax(qk^T/sqrt(d))v up to float tolerance.
+    """
+    n = mesh.shape[axis]
+    H = q.shape[1]
+    if H % n != 0:
+        raise ValueError(
+            f"ulysses_attention needs heads ({H}) divisible by the "
+            f"'{axis}' axis size ({n}); use ring_attention otherwise")
+
+    def shard_fn(q_blk, k_blk, v_blk):
+        # seq-sharded -> head-sharded: split heads, concat time blocks
+        # (device order == time order, so concatenation restores the
+        # global sequence)
+        def to_heads(x):
+            return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+        from deeplearning4j_tpu.nn.conf.layers.attention import (
+            scaled_dot_attention,
+        )
+
+        ql, kl, vl = to_heads(q_blk), to_heads(k_blk), to_heads(v_blk)
+        o = scaled_dot_attention(ql, kl, vl, causal=causal)
+        # head-sharded -> seq-sharded
+        return lax.all_to_all(o, axis, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    spec = P(None, None, axis, None)
+    fn = jax.shard_map(shard_fn, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return fn(q, k, v)
+
+
 def sequence_parallel_self_attention(layer, params, x, *, mesh: Mesh,
                                      axis: str = SEQ_AXIS,
-                                     causal=None):
+                                     causal=None, impl: str = "ring"):
     """Run a SelfAttentionLayer forward with the sequence axis sharded:
     pointwise projections stay local to each time shard; the attention core
-    is the ring. Inference-mode equal to ``layer.forward`` (incl. the output
-    activation; no mask support — pad to multiples of the axis size instead,
-    standard for long-context)."""
+    is the ring (``impl='ring'``) or two all-to-alls (``impl='ulysses'``,
+    needs heads divisible by the axis size). Inference-mode equal to
+    ``layer.forward`` (incl. the output activation; no mask support — pad
+    to multiples of the axis size instead, standard for long-context)."""
     causal = layer.causal if causal is None else causal
     H = layer.n_heads
 
@@ -103,7 +154,11 @@ def sequence_parallel_self_attention(layer, params, x, *, mesh: Mesh,
 
     q, k, v = (project(params["Wq"]), project(params["Wk"]),
                project(params["Wv"]))
-    o = ring_attention(q, k, v, mesh=mesh, axis=axis, causal=causal)
+    impls = {"ring": ring_attention, "ulysses": ulysses_attention}
+    if impl not in impls:
+        raise ValueError(f"impl must be one of {sorted(impls)}, "
+                         f"got '{impl}'")
+    o = impls[impl](q, k, v, mesh=mesh, axis=axis, causal=causal)
     B, H_, T, d = o.shape
     o = o.transpose(0, 2, 1, 3).reshape(B, T, H_ * d)
     out = jnp.einsum("bto,op->btp", o, params["Wo"]) + params["b"]

@@ -1,0 +1,151 @@
+"""Invalid configs fail at build() with named-layer messages, not raw XLA
+shape errors at fit time (ports the intent of
+deeplearning4j-core/src/test/.../exceptions/TestInvalidConfigurations.java)."""
+
+import pytest
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers.convolution import (
+    ConvolutionLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.conf.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.layers.recurrent import LSTM
+from deeplearning4j_tpu.nn.updater import Sgd
+
+
+def _mln(*layers, input_type=None):
+    b = (NeuralNetConfiguration.builder().seed(1)
+         .updater(Sgd(learning_rate=0.1)).list(*layers))
+    if input_type is not None:
+        b = b.set_input_type(input_type)
+    return b.build()
+
+
+class TestZeroSizes:
+    def test_dense_nout_0(self):
+        with pytest.raises(ValueError, match="n_out must be > 0"):
+            _mln(DenseLayer(n_out=0),
+                 OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+                 input_type=InputType.feed_forward(4))
+
+    def test_dense_nin_unset_without_input_type(self):
+        with pytest.raises(ValueError, match="n_in must be > 0"):
+            _mln(DenseLayer(n_out=8),
+                 OutputLayer(n_in=8, n_out=3, activation="softmax",
+                             loss="mcxent"))
+
+    def test_output_nout_0(self):
+        with pytest.raises(ValueError, match="n_out must be > 0"):
+            _mln(DenseLayer(n_out=8),
+                 OutputLayer(n_out=0, activation="softmax", loss="mcxent"),
+                 input_type=InputType.feed_forward(4))
+
+    def test_lstm_nout_0(self):
+        with pytest.raises(ValueError, match="n_out must be > 0"):
+            _mln(LSTM(n_out=0),
+                 OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+                 input_type=InputType.recurrent(5))
+
+    def test_conv_nout_0(self):
+        with pytest.raises(ValueError, match="n_out must be > 0"):
+            _mln(ConvolutionLayer(n_out=0, kernel_size=(3, 3)),
+                 OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+                 input_type=InputType.convolutional(8, 8, 1))
+
+    def test_error_names_the_layer(self):
+        with pytest.raises(ValueError, match="hidden2"):
+            _mln(DenseLayer(n_out=8),
+                 DenseLayer(n_out=0, name="hidden2"),
+                 OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+                 input_type=InputType.feed_forward(4))
+
+
+class TestConvGeometry:
+    def test_invalid_kernel(self):
+        with pytest.raises(ValueError, match="kernel.*positive"):
+            _mln(ConvolutionLayer(n_out=4, kernel_size=(0, 3)),
+                 OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+                 input_type=InputType.convolutional(8, 8, 1))
+
+    def test_invalid_stride(self):
+        with pytest.raises(ValueError, match="stride.*positive"):
+            _mln(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                  stride=(0, 1)),
+                 OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+                 input_type=InputType.convolutional(8, 8, 1))
+
+    def test_negative_padding(self):
+        with pytest.raises(ValueError, match="padding.*non-negative"):
+            _mln(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                  padding=(-1, 0)),
+                 OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+                 input_type=InputType.convolutional(8, 8, 1))
+
+    def test_subsampling_invalid_kernel(self):
+        with pytest.raises(ValueError, match="kernel.*positive"):
+            _mln(ConvolutionLayer(n_out=4, kernel_size=(3, 3)),
+                 SubsamplingLayer(kernel_size=(0, 2)),
+                 OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+                 input_type=InputType.convolutional(8, 8, 1))
+
+    def test_input_smaller_than_kernel(self):
+        # 8x8 input, 5x5 kernel, then a second 5x5 on the resulting 4x4
+        with pytest.raises(ValueError, match="smaller than the .padded. "
+                                             "kernel"):
+            _mln(ConvolutionLayer(n_out=4, kernel_size=(5, 5)),
+                 ConvolutionLayer(n_out=4, kernel_size=(5, 5)),
+                 OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+                 input_type=InputType.convolutional(8, 8, 1))
+
+    def test_strict_mode_indivisible_stride(self):
+        with pytest.raises(ValueError, match="Strict"):
+            _mln(ConvolutionLayer(n_out=4, kernel_size=(2, 2),
+                                  stride=(2, 2),
+                                  convolution_mode="strict"),
+                 OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+                 input_type=InputType.convolutional(9, 9, 1))
+
+
+class TestValidStillBuilds:
+    def test_good_cnn_builds(self):
+        conf = _mln(ConvolutionLayer(n_out=4, kernel_size=(3, 3)),
+                    SubsamplingLayer(kernel_size=(2, 2)),
+                    OutputLayer(n_out=3, activation="softmax",
+                                loss="mcxent"),
+                    input_type=InputType.convolutional(8, 8, 1))
+        assert conf is not None
+
+
+class TestValidationBypassesClosed:
+    """Regressions for paths that skipped the base check: validate()
+    overrides, wrapper layers, and graphs without declared input types."""
+
+    def test_attention_without_input_type(self):
+        from deeplearning4j_tpu.nn.conf.layers.attention import (
+            SelfAttentionLayer,
+        )
+        with pytest.raises(ValueError, match="n_in must be > 0"):
+            _mln(SelfAttentionLayer(n_out=16, n_heads=4),
+                 OutputLayer(n_in=16, n_out=3, activation="softmax",
+                             loss="mcxent"))
+
+    def test_frozen_wrapper_validates_inner(self):
+        from deeplearning4j_tpu.nn.conf.layers.misc import FrozenLayer
+        with pytest.raises(ValueError, match="n_out must be > 0"):
+            _mln(FrozenLayer(inner=DenseLayer(n_in=4, n_out=0)),
+                 OutputLayer(n_in=8, n_out=3, activation="softmax",
+                             loss="mcxent"))
+
+    def test_graph_without_input_types_still_validates(self):
+        b = (NeuralNetConfiguration.builder().seed(1)
+             .updater(Sgd(learning_rate=0.1)).graph_builder()
+             .add_inputs("in"))
+        b.add_layer("bad", DenseLayer(n_in=4, n_out=0), "in")
+        b.add_layer("out", OutputLayer(n_in=8, n_out=3,
+                                       activation="softmax",
+                                       loss="mcxent"), "bad")
+        b.set_outputs("out")
+        with pytest.raises(ValueError, match="n_out must be > 0"):
+            b.build()

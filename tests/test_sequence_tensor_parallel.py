@@ -174,3 +174,75 @@ class TestTensorParallel:
         for _ in range(60):
             params, loss = step(params, jnp.asarray(x), jnp.asarray(y))
         assert float(loss) < float(first) * 0.5
+
+
+class TestUlyssesAttention:
+    """All-to-all sequence parallelism (Jacobs et al. 2023): the second SP
+    implementation, head-sharded compute between two all_to_alls."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_single_device(self, causal):
+        from deeplearning4j_tpu.parallel.sequence import ulysses_attention
+
+        rs = np.random.RandomState(2)
+        B, H, T, d = 2, 8, 32, 4  # H = 8 over 8 devices -> 1 head each
+        q = jnp.asarray(rs.randn(B, H, T, d), jnp.float32)
+        k = jnp.asarray(rs.randn(B, H, T, d), jnp.float32)
+        v = jnp.asarray(rs.randn(B, H, T, d), jnp.float32)
+        expected = scaled_dot_attention(q, k, v, causal=causal)
+        got = ulysses_attention(q, k, v, mesh=_seq_mesh(), axis="seq",
+                                causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_gradients_match_dense(self):
+        from deeplearning4j_tpu.parallel.sequence import ulysses_attention
+
+        rs = np.random.RandomState(3)
+        B, H, T, d = 1, 8, 16, 4
+        q = jnp.asarray(rs.randn(B, H, T, d), jnp.float32)
+        k = jnp.asarray(rs.randn(B, H, T, d), jnp.float32)
+        v = jnp.asarray(rs.randn(B, H, T, d), jnp.float32)
+        mesh = _seq_mesh()
+
+        def u_loss(q, k, v):
+            return jnp.sum(ulysses_attention(q, k, v, mesh=mesh,
+                                             axis="seq", causal=True) ** 2)
+
+        def dense_loss(q, k, v):
+            return jnp.sum(scaled_dot_attention(q, k, v, causal=True) ** 2)
+
+        gu = jax.grad(u_loss, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gu, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-5, rtol=2e-5)
+
+    def test_indivisible_heads_rejected(self):
+        from deeplearning4j_tpu.parallel.sequence import ulysses_attention
+
+        rs = np.random.RandomState(4)
+        q = jnp.asarray(rs.randn(1, 3, 16, 4), jnp.float32)  # 3 heads, 8 dev
+        with pytest.raises(ValueError, match="divisible"):
+            ulysses_attention(q, q, q, mesh=_seq_mesh(), axis="seq")
+
+    def test_layer_wrapper_ulysses_impl(self):
+        from deeplearning4j_tpu.nn.conf.layers.attention import (
+            SelfAttentionLayer,
+        )
+        from deeplearning4j_tpu.parallel.sequence import (
+            sequence_parallel_self_attention,
+        )
+
+        rs = np.random.RandomState(5)
+        layer = SelfAttentionLayer(n_in=16, n_out=16, n_heads=8,
+                                   causal=True, activation="identity")
+        layer.finalize(None)
+        params = layer.init_params(jax.random.PRNGKey(0))
+        x = jnp.asarray(rs.randn(2, 32, 16), jnp.float32)
+        expected, _ = layer.forward(params, {}, x, train=False)
+        got = sequence_parallel_self_attention(layer, params, x,
+                                               mesh=_seq_mesh(),
+                                               impl="ulysses")
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                                   atol=2e-5, rtol=2e-5)
