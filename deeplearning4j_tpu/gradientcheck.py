@@ -48,6 +48,13 @@ def check_gradients(net, x, y, input_mask=None, label_mask=None, *, eps: float =
 
     loss_jit = jax.jit(loss_of)
     analytic_tree = jax.grad(loss_of)(params0)
+    # the loss stop_gradients the l1/l2 penalty and the train step adds its
+    # closed form instead; mirror that here so the analytic side matches
+    # what training uses — the finite differences naturally include the
+    # penalty
+    from deeplearning4j_tpu.nn.regularization import add_regularization_grads
+
+    analytic_tree = add_regularization_grads(net, params0, analytic_tree)
     if isinstance(layers, list):
         analytic = flatten_params(analytic_tree, layers).astype(np.float64)
         flat0 = flatten_params(params0, layers).astype(np.float64)

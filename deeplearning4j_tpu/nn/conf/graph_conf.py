@@ -79,6 +79,9 @@ class GraphVertex:
     def regularization(self, params):
         return 0.0
 
+    def regularization_grad(self, params):
+        return {}
+
     def output_type(self, input_types: list) -> InputType:
         return input_types[0]
 
@@ -119,6 +122,9 @@ class LayerVertex(GraphVertex):
 
     def regularization(self, params):
         return self.layer.regularization(params)
+
+    def regularization_grad(self, params):
+        return self.layer.regularization_grad(params)
 
     def output_type(self, input_types):
         it = input_types[0]

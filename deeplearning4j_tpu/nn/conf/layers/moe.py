@@ -117,3 +117,12 @@ class MixtureOfExpertsLayer(FeedForwardLayer):
             reg = reg + self.load_balance_coef * jnp.sum(
                 jnp.square(params["Wg"]))
         return reg
+
+    def regularization_grad(self, params):
+        out = super().regularization_grad(params)
+        # closed form of the coef*sum(Wg^2) term above (no 0.5 factor,
+        # unlike the base l2 form)
+        if self.load_balance_coef:
+            g = 2.0 * self.load_balance_coef * params["Wg"]
+            out["Wg"] = out.get("Wg", 0) + g
+        return out

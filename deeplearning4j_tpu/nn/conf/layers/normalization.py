@@ -56,6 +56,9 @@ class BatchNormalization(BaseLayer):
         # nn/layers/normalization/BatchNormalization.java:70-76 calcL1/calcL2 -> 0)
         return 0.0
 
+    def regularization_grad(self, params: dict) -> dict:
+        return {}  # mirrors regularization() == 0
+
     def init_params(self, rng, dtype=jnp.float32):
         if self.lock_gamma_beta:
             return {}

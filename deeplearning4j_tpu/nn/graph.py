@@ -26,6 +26,7 @@ from deeplearning4j_tpu.nn.conf.graph_conf import (
 )
 from deeplearning4j_tpu.nn.conf.layers.misc import CenterLossOutputLayer
 from deeplearning4j_tpu.nn.conf.preprocessors import preprocessor_key
+from deeplearning4j_tpu.nn.regularization import add_regularization_grads
 from deeplearning4j_tpu.nn.gradient_normalization import (
     apply_gradient_normalization,
     layer_map_for,
@@ -191,6 +192,10 @@ class ComputationGraph:
         reg = 0.0
         for name in conf.topo_order:
             reg = reg + conf.vertices[name].regularization(params.get(name, {}))
+        # penalty value reported, not differentiated — the step adds the
+        # closed-form regularization_grad (see MultiLayerNetwork._loss)
+        if not isinstance(reg, float):
+            reg = jax.lax.stop_gradient(reg)
         return total + reg, (new_states, new_carry, last_in_by_out)
 
     # ------------------------------------------------------------ train step
@@ -237,6 +242,7 @@ class ComputationGraph:
 
             (loss, (new_states, new_carry, last_ins)), grads = \
                 jax.value_and_grad(loss_fn, has_aux=True)(params)
+            grads = add_regularization_grads(self, params, grads)
             grads = apply_gradient_normalization(layer_map_for(self), grads)
             if lr_mults is not None:
                 steps, opt_state2 = updater.step(grads, opt_state, iteration,

@@ -28,6 +28,7 @@ import numpy as np
 from deeplearning4j_tpu.nn.conf.builders import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.conf.layers.misc import CenterLossOutputLayer
 from deeplearning4j_tpu.nn.conf.preprocessors import preprocessor_key
+from deeplearning4j_tpu.nn.regularization import add_regularization_grads
 from deeplearning4j_tpu.nn.gradient_normalization import (
     apply_gradient_normalization,
     layer_map_for,
@@ -169,6 +170,13 @@ class MultiLayerNetwork:
         reg = 0.0
         for i, layer in enumerate(self.layers):
             reg = reg + layer.regularization(params[str(i)])
+        # the penalty VALUE stays in the reported score (reference:
+        # computeScore adds fullNetworkL1+L2) but is not differentiated —
+        # the train step adds the closed-form regularization_grad instead
+        # (autodiff through these reductions measured 30% of the ResNet50
+        # step, profiles/README.md)
+        if not isinstance(reg, float):
+            reg = jax.lax.stop_gradient(reg)
         new_states[str(out_idx)] = state.get(str(out_idx), {})
         return data_loss + reg, (new_states, new_carry, last_in)
 
@@ -210,6 +218,7 @@ class MultiLayerNetwork:
 
             (loss, (new_states, new_carry, last_in)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
+            grads = add_regularization_grads(self, params, grads)
             grads = apply_gradient_normalization(layer_map_for(self), grads)
             if lr_mults is not None:
                 steps, opt_state2 = updater.step(grads, opt_state, iteration,

@@ -1,0 +1,38 @@
+"""Closed-form L1/L2 gradient application, shared by every gradient path.
+
+The nets' ``_loss`` reports the penalty VALUE but stop_gradients it
+(autodiff through the per-tensor reductions measured 30% of the ResNet50
+train step, profiles/README.md); every consumer of ``jax.grad`` over a
+net loss must therefore add the closed form ``l2*W + l1*sign(W)`` back.
+This is also the reference's own architecture: DL4J applies l1/l2 inside
+the updater (nn/updater/BaseUpdater postApply), not through backprop.
+
+One helper, four call sites (MultiLayerNetwork/ComputationGraph steps,
+gradient checker, solvers, ParallelWrapper) — the bug class this kills is
+a fifth gradient path silently training without weight decay.
+"""
+
+from __future__ import annotations
+
+
+def add_regularization_grads(net, params, grads):
+    """Return ``grads`` with each layer's analytic penalty gradient added.
+
+    Works for MultiLayerNetwork (int-keyed layers) and ComputationGraph
+    (vertex-name keys); mutates the (freshly autodiff-produced) ``grads``
+    dict trees in place and returns them.
+    """
+    layers = getattr(net, "layers", None)
+    if isinstance(layers, list):
+        for i, layer in enumerate(layers):
+            sub = params.get(str(i), {})
+            for k, g in layer.regularization_grad(sub).items():
+                grads[str(i)][k] = grads[str(i)][k] + g
+        return grads
+    vertices = getattr(getattr(net, "conf", None), "vertices", None)
+    if isinstance(vertices, dict):
+        for name, v in vertices.items():
+            sub = params.get(name, {})
+            for k, g in v.regularization_grad(sub).items():
+                grads[name][k] = grads[name][k] + g
+    return grads

@@ -111,7 +111,22 @@ class BaseOptimizer:
                                 train=False, rng=None)
             return loss
 
-        vg = jax.jit(jax.value_and_grad(value))
+        @jax.jit
+        def vg(flat):
+            loss, g = jax.value_and_grad(value)(flat)
+            # the loss stop_gradients the l1/l2 penalty; add its closed
+            # form like the train step does (nn/regularization.py)
+            from jax.flatten_util import ravel_pytree as _rp
+
+            from deeplearning4j_tpu.nn.regularization import (
+                add_regularization_grads,
+            )
+
+            params = unravel(flat)
+            gtree = unravel(g)
+            gtree = add_regularization_grads(net, params, gtree)
+            return loss, _rp(gtree)[0]
+
         return flat0, unravel, value, vg
 
     def optimize(self, net, x, y) -> float:

@@ -119,11 +119,24 @@ class PipelineTrainer:
     def _stage_reg(self, s, params):
         """This stage's share of the L1/L2 term MultiLayerNetwork._loss
         adds (regularization is a per-layer sum, so it localizes to
-        stages exactly)."""
+        stages exactly). Value only — gradients use the closed form below
+        (same split as nn/regularization.py)."""
         reg = 0.0
         for i in self.stages[s]:
             reg = reg + self.net.layers[i].regularization(params[str(i)])
+        if not isinstance(reg, float):
+            reg = jax.lax.stop_gradient(reg)
         return reg
+
+    def _add_stage_reg_grads(self, s, params, dp):
+        """Closed-form l1/l2 gradients for this stage's layers, added into
+        the stage gradient tree (the pipeline analog of
+        nn.regularization.add_regularization_grads)."""
+        for i in self.stages[s]:
+            sub = params.get(str(i), {})
+            for k, g in self.net.layers[i].regularization_grad(sub).items():
+                dp[str(i)][k] = dp[str(i)][k] + g
+        return dp
 
     def _stage_has_reg(self, s):
         return any(getattr(self.net.layers[i], f, None)
@@ -166,6 +179,7 @@ class PipelineTrainer:
                     lambda p, xx: self._last_stage_loss(s, p, state, xx, y,
                                                         rng)[0],
                     argnums=(0, 1))(params, x)
+                dp = self._add_stage_reg_grads(s, params, dp)
                 return loss, dp, dx
             return jax.jit(bwd)
 
@@ -180,9 +194,9 @@ class PipelineTrainer:
             dp, dx = vjp(dy)
             if has_reg:
                 # the reg term does not flow through dy — add its local
-                # gradient directly (single-device adds it to the loss)
-                dreg = jax.grad(lambda p: self._stage_reg(s, p))(params)
-                dp = jax.tree_util.tree_map(jnp.add, dp, dreg)
+                # closed-form gradient directly (single-device adds it to
+                # the loss the same way)
+                dp = self._add_stage_reg_grads(s, params, dp)
             return dp, dx
         return jax.jit(bwd)
 

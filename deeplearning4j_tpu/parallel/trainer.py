@@ -33,6 +33,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from deeplearning4j_tpu.nn.regularization import add_regularization_grads
 from deeplearning4j_tpu.nn.gradient_normalization import (
     apply_gradient_normalization,
     layer_map_for,
@@ -111,6 +112,7 @@ class ParallelWrapper:
 
                 (loss, (new_states, _, last_in)), grads = jax.value_and_grad(
                     loss_fn, has_aux=True)(params)
+                grads = add_regularization_grads(net, params, grads)
                 if pmean_grads:
                     grads = lax.pmean(grads, DATA_AXIS)
                 # after the pmean: SHARED_GRADIENTS normalizes the global

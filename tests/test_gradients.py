@@ -201,3 +201,41 @@ def test_embedding_gradients():
     x = rng.integers(0, 7, (5, 1)).astype(float)
     y = _onehot(rng, 5, 3)
     assert check_gradients(net, x, y, verbose=True)
+
+
+def test_bn_with_global_l2_gradients():
+    """BatchNormalization gamma/beta are exempt from l1/l2 (reference:
+    BatchNormalization.calcL1/calcL2 -> 0): the closed-form reg-grad path
+    must not decay them even when a global l2 fills the layer's fields."""
+    rng = np.random.default_rng(4)
+    net = _build([DenseLayer(n_out=6, activation="tanh"),
+                  BatchNormalization(),
+                  OutputLayer(n_out=3, loss="mcxent", activation="softmax")],
+                 InputType.feed_forward(4), l1=0.01, l2=0.02)
+    x = rng.normal(0, 1, (6, 4))
+    y = _onehot(rng, 6, 3)
+    assert check_gradients(net, x, y, train=False)
+
+
+def test_moe_load_balance_term_trains():
+    """The MoE load-balance auxiliary must still produce a gradient on the
+    gate weights after the closed-form reg split (it is stop_gradient-ed
+    in the loss value and re-added analytically)."""
+    from deeplearning4j_tpu.nn.conf.layers.moe import MixtureOfExpertsLayer
+
+    layer = MixtureOfExpertsLayer(n_in=4, n_out=4, n_experts=2,
+                                  expert_hidden=8, load_balance_coef=0.1)
+    import jax
+    params = layer.init_params(jax.random.PRNGKey(0))
+    g = layer.regularization_grad(params)
+    np.testing.assert_allclose(np.asarray(g["Wg"]),
+                               2 * 0.1 * np.asarray(params["Wg"]))
+    # and finite differences agree end-to-end through a network
+    net = _build([MixtureOfExpertsLayer(n_out=4, n_experts=2, expert_hidden=8,
+                                        load_balance_coef=0.05),
+                  OutputLayer(n_out=3, loss="mcxent", activation="softmax")],
+                 InputType.feed_forward(4))
+    rng = np.random.default_rng(5)
+    x = rng.normal(0, 1, (6, 4))
+    y = _onehot(rng, 6, 3)
+    assert check_gradients(net, x, y, train=False)
