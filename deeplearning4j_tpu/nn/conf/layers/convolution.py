@@ -303,6 +303,11 @@ class SubsamplingLayer(Layer):
         return [(0, 0), (ph, ph), (pw, pw), (0, 0)]
 
     def forward(self, params, state, x, *, mask=None, train=False, rng=None):
+        # NOTE(perf, measured): a reshape+max fast path for kernel==stride
+        # pooling was tried and REVERTED — on v5e the reshape backward
+        # (broadcast-compare over the windowed view) measured 5.45 ms
+        # fwd+bwd vs 4.36 ms for reduce_window's select_and_scatter at
+        # [256,56,56,64] 2x2/2. XLA's lowering is already the right one.
         x = self.apply_input_dropout(x, train=train, rng=rng)
         kh, kw = self.kernel_size
         sh, sw = self.stride

@@ -262,9 +262,11 @@ def _flash_backward(q, k, v, o, lse, do, *, causal: bool, block_q: int,
 DEFAULT_BLOCK = 512  # tuned on v5e: T=2048 1.5x, T=4096 2.9x over stock
 
 # Each program holds full K and V [T, d] blocks in VMEM as f32 (~2*T*d*4
-# bytes) plus the q/o blocks and accumulators; cap K+V at ~8 MiB of the
-# ~16 MiB VMEM so long sequences fall back to stock instead of crashing.
-VMEM_SEQ_ELEMS_LIMIT = 1 << 20  # T * d ceiling (8192 * 128)
+# bytes) plus the q/o blocks and accumulators; cap T*d so long sequences
+# fall back to stock instead of crashing. Limit set EMPIRICALLY on v5e:
+# T=4096, d=128 (T*d = 2^19) compiles (training needs the vjp block_q
+# shrink below); T=8192, d=128 (2^20) fails scoped-VMEM even forward-only.
+VMEM_SEQ_ELEMS_LIMIT = 1 << 19  # inclusive T * d ceiling (4096 * 128)
 
 
 def supports(q_shape, *, mask, dtype=jnp.float32,
@@ -311,21 +313,39 @@ def flash_attention(q, k, v, *, causal: bool = False,
     memory for training too, unlike a stock-XLA vjp which would
     re-materialise the [B,H,T,T] score matrix in HBM."""
     T = q.shape[2]
+    d = q.shape[3]
     block_q = min(block_q, T)
     block_k = min(block_k, T)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     fwd = functools.partial(_flash_forward, causal=causal, block_q=block_q,
                             block_k=block_k, interpret=interpret)
-    bwd = functools.partial(_flash_backward, causal=causal, block_q=block_q,
-                            block_k=block_k, interpret=interpret)
+    # The DIFFERENTIATED forward compiles in a jvp context where XLA's
+    # scoped-VMEM accounting is tighter: at T=4096, d=128 the default
+    # block_q=512 exceeds the 16 MiB limit by ~84 KiB (measured OOM) while
+    # the primal-only call compiles fine. Shrink block_q for the vjp
+    # forward only — the primal path keeps the faster big block (measured:
+    # fwd 512/512 4.60 ms vs 256/512 5.26 ms; training 256/512 11.3 ms
+    # where 512/512 cannot compile at all).
+    vjp_block_q = block_q
+    if T * d >= (1 << 19) and block_q > 256 and T % 256 == 0:
+        # only when 256 keeps the grid covering T exactly — a non-divisor
+        # would silently drop tail rows; shapes the shrink cannot help
+        # keep the old block and fail loudly at compile instead
+        vjp_block_q = 256
+    vjp_fwd = functools.partial(_flash_forward, causal=causal,
+                                block_q=vjp_block_q, block_k=block_k,
+                                interpret=interpret)
+    bwd = functools.partial(_flash_backward, causal=causal,
+                            block_q=vjp_block_q, block_k=block_k,
+                            interpret=interpret)
 
     @jax.custom_vjp
     def attn(q, k, v):
         return fwd(q, k, v)[0]
 
     def attn_fwd(q, k, v):
-        o, lse = fwd(q, k, v)
+        o, lse = vjp_fwd(q, k, v)
         return o, (q, k, v, o, lse)
 
     def attn_bwd(res, g):

@@ -83,7 +83,10 @@ class TestFlashAttentionKernel:
         # off-TPU the kernel would run in interpret mode: decline
         assert not supports((2, 3, 256, 64), mask=None, backend="cpu")
         # full K/V live in VMEM per program: decline past the ceiling
-        assert supports((2, 3, 8192, 128), **ok)
+        # (empirical on v5e: 4096x128 compiles, 8192x128 does not)
+        assert supports((2, 3, 4096, 128), **ok)
+        assert supports((2, 3, 8192, 64), **ok)
+        assert not supports((2, 3, 8192, 128), **ok)
         assert not supports((2, 3, 16384, 128), **ok)
 
 
