@@ -1,5 +1,19 @@
-"""k-NN REST server (reference: deeplearning4j-nearestneighbor-server)."""
+"""REST k-NN service (reference: deeplearning4j-nearestneighbor-server).
+
+``DeviceBruteForceIndex`` is re-exported lazily so host-only VPTree users
+never pay the jax import.
+"""
 
 from deeplearning4j_tpu.nearestneighbors.server import NearestNeighborsServer
 
-__all__ = ["NearestNeighborsServer"]
+__all__ = ["DeviceBruteForceIndex", "NearestNeighborsServer"]
+
+
+def __getattr__(name):
+    if name == "DeviceBruteForceIndex":
+        from deeplearning4j_tpu.nearestneighbors.brute import (
+            DeviceBruteForceIndex,
+        )
+
+        return DeviceBruteForceIndex
+    raise AttributeError(name)

@@ -22,9 +22,28 @@ from deeplearning4j_tpu.clustering.vptree import VPTree
 
 
 class NearestNeighborsServer:
-    def __init__(self, points, port: int = 0, metric: str = "euclidean"):
-        self.points = np.asarray(points, np.float64)
-        self.tree = VPTree(self.points, metric=metric)
+    """``backend="vptree"`` (host, reference-style pruning tree) or
+    ``backend="device"`` (exact brute force: one MXU matmul + top_k per
+    query batch — the TPU-idiomatic index, see brute.py)."""
+
+    def __init__(self, points, port: int = 0, metric: str = "euclidean",
+                 backend: str = "vptree"):
+        points = np.asarray(points)
+        self.shape = points.shape
+        if backend == "vptree":
+            self.tree = VPTree(np.asarray(points, np.float64),
+                               metric=metric)
+        elif backend == "device":
+            from deeplearning4j_tpu.nearestneighbors.brute import (
+                DeviceBruteForceIndex,
+            )
+
+            # the index keeps its own f32 device copy; no host copy pinned
+            self.tree = DeviceBruteForceIndex(points, metric=metric)
+        else:
+            raise ValueError(
+                f"backend must be vptree|device, got '{backend}'")
+        self.backend = backend
         self._port = port
         self._httpd = None
         self._thread = None
@@ -50,8 +69,8 @@ class NearestNeighborsServer:
 
             def do_GET(self):
                 if self.path == "/status":
-                    self._json({"points": int(server.points.shape[0]),
-                                "dims": int(server.points.shape[1])})
+                    self._json({"points": int(server.shape[0]),
+                                "dims": int(server.shape[1])})
                 else:
                     self._json({"error": "not found"}, 404)
 

@@ -285,3 +285,58 @@ def test_dataset_without_labels_supports_all_helpers():
     assert all(b.labels is None for b in batches)
     merged = DataSet.merge(batches)
     assert merged.labels is None and merged.num_examples() == 10
+
+
+class TestDeviceBruteForceKnn:
+    """TPU-idiomatic k-NN index (one matmul + top_k) vs the reference-style
+    VPTree: exact agreement, both metrics, and through the REST server."""
+
+    def _data(self, n=300, d=16, seed=0):
+        rs = np.random.RandomState(seed)
+        return rs.randn(n, d).astype(np.float32)
+
+    def test_matches_vptree_euclidean(self):
+        from deeplearning4j_tpu.nearestneighbors.brute import (
+            DeviceBruteForceIndex,
+        )
+
+        pts = self._data()
+        tree = VPTree(pts)
+        idx = DeviceBruteForceIndex(pts)
+        q = self._data(5, 16, seed=1)
+        for i in range(5):
+            ref = tree.search(q[i], 7)
+            got = idx.search(q[i], 7)
+            assert [r[1] for r in ref] == [g[1] for g in got]
+            np.testing.assert_allclose([r[0] for r in ref],
+                                       [g[0] for g in got], rtol=1e-4)
+
+    def test_cosine_metric_self_nearest(self):
+        from deeplearning4j_tpu.nearestneighbors.brute import (
+            DeviceBruteForceIndex,
+        )
+
+        pts = self._data(50, 8)
+        idx = DeviceBruteForceIndex(pts, metric="cosine")
+        d, ii = idx.search_batch_arrays(pts * 3.0, k=1)  # scale-invariant
+        np.testing.assert_array_equal(ii[:, 0], np.arange(50))
+        assert float(d.max()) < 1e-5
+
+    def test_server_device_backend(self):
+        pts = self._data(100, 8)
+        server = NearestNeighborsServer(pts, backend="device")
+        port = server.start()
+        try:
+            base = f"http://127.0.0.1:{port}"
+            body = json.dumps({"k": 3,
+                               "points": pts[:4].tolist()}).encode()
+            req = urllib.request.Request(base + "/knnVector", data=body)
+            res = json.loads(urllib.request.urlopen(req).read())
+            assert [r[0]["index"] for r in res["results"]] == [0, 1, 2, 3]
+            one = json.loads(urllib.request.urlopen(urllib.request.Request(
+                base + "/knn",
+                data=json.dumps({"k": 2,
+                                 "point": pts[7].tolist()}).encode())).read())
+            assert one["results"][0]["index"] == 7
+        finally:
+            server.stop()
