@@ -19,12 +19,14 @@ from functools import partial
 
 @partial(jax.jit, static_argnames=("k", "metric"))
 def _knn(points, sq_norms, queries, *, k: int, metric: str):
+    # NOTE: deliberately NOT shared with clustering.VPTree — that is the
+    # host/float64 reference-style index that must work without a device;
+    # this is the device kernel (same split as plot.Tsne exact vs BH).
     if metric == "cosine":
-        p = points / jnp.maximum(jnp.linalg.norm(points, axis=1,
-                                                 keepdims=True), 1e-12)
+        # points arrive pre-normalized from __init__ (uploaded once)
         q = queries / jnp.maximum(jnp.linalg.norm(queries, axis=1,
                                                   keepdims=True), 1e-12)
-        dists = jnp.maximum(1.0 - q @ p.T, 0.0)
+        dists = jnp.maximum(1.0 - q @ points.T, 0.0)
     else:  # euclidean: ||q||^2 - 2 q.p + ||p||^2, computed via the matmul
         qn = jnp.sum(queries * queries, axis=1, keepdims=True)
         dots = queries @ points.T
@@ -51,6 +53,10 @@ class DeviceBruteForceIndex:
             raise ValueError(f"points must be [N, D], got {pts.shape}")
         self.metric = metric
         self.points = jnp.asarray(pts)
+        if metric == "cosine":
+            # normalize ONCE at upload; per-query work stays O(Q*D)
+            self.points = self.points / jnp.maximum(
+                jnp.linalg.norm(self.points, axis=1, keepdims=True), 1e-12)
         self._sq_norms = jnp.sum(self.points * self.points, axis=1)
 
     @property
@@ -60,10 +66,11 @@ class DeviceBruteForceIndex:
     def search_batch_arrays(self, queries, k: int):
         """(distances [Q, k], indices [Q, k]) as numpy, nearest first.
 
-        Query batches are padded up to power-of-two buckets before the
-        jitted kernel so a stream of varying batch sizes compiles
-        O(log Q_max) programs, not one per distinct size (an XLA compile
-        inside a REST handler is a multi-hundred-ms stall)."""
+        Query batch size AND k are padded up to power-of-two buckets
+        before the jitted kernel so streams of varying sizes compile
+        O(log Q_max * log k_max) programs, not one per distinct (Q, k)
+        (an XLA compile inside a REST handler is a multi-hundred-ms
+        stall); results are sliced back to the requested shape."""
         q = np.atleast_2d(np.asarray(queries, np.float32))
         k = min(k, self.n_points)
         Q = q.shape[0]
@@ -71,9 +78,10 @@ class DeviceBruteForceIndex:
         if bucket != Q:
             q = np.concatenate([q, np.zeros((bucket - Q, q.shape[1]),
                                             np.float32)])
+        kb = min(1 << max(k - 1, 0).bit_length(), self.n_points)
         d, idx = _knn(self.points, self._sq_norms, jnp.asarray(q),
-                      k=k, metric=self.metric)
-        return np.asarray(d)[:Q], np.asarray(idx)[:Q]
+                      k=kb, metric=self.metric)
+        return np.asarray(d)[:Q, :k], np.asarray(idx)[:Q, :k]
 
     def search_batch(self, queries, k: int) -> list:
         """VPTree.search_batch-compatible: per query a list of

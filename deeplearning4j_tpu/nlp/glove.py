@@ -21,8 +21,36 @@ from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
 from deeplearning4j_tpu.nlp.vocab import VocabConstructor
 
 
-@partial(jax.jit, static_argnames=())
-def _glove_step(W, Wc, b, bc, hW, hWc, hb, hbc, wi, wj, logx, fx, lr, eps):
+@partial(jax.jit, static_argnames=("batch",),
+         donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+def _glove_epoch(W, Wc, b, bc, hW, hWc, hb, hbc, wi, wj, logx, fx, key,
+                 lr, eps, *, batch: int):
+    """One whole epoch as a single device program: shuffle the nonzero
+    triples with the on-device PRNG, then `lax.scan` AdaGrad batches —
+    one dispatch per EPOCH instead of one per batch (the same
+    dispatch-granularity change that made skipgram fast; padding triples
+    carry fx=0 so they contribute exactly nothing)."""
+    n = wi.shape[0]
+    perm = jax.random.permutation(key, n)
+    nb = n // batch
+
+    def gather(a):
+        return a[perm].reshape(nb, batch, *a.shape[1:])
+
+    xs = (gather(wi), gather(wj), gather(logx), gather(fx))
+
+    def body(carry, inp):
+        W, Wc, b, bc, hW, hWc, hb, hbc = carry
+        bwi, bwj, blogx, bfx = inp
+        out = _glove_batch(W, Wc, b, bc, hW, hWc, hb, hbc, bwi, bwj,
+                           blogx, bfx, lr, eps)
+        return out, 0
+
+    carry, _ = jax.lax.scan(body, (W, Wc, b, bc, hW, hWc, hb, hbc), xs)
+    return carry
+
+
+def _glove_batch(W, Wc, b, bc, hW, hWc, hb, hbc, wi, wj, logx, fx, lr, eps):
     """One AdaGrad batch over triples (wi, wj, X)."""
     vi = W[wi]      # [B, D]
     vj = Wc[wj]
@@ -116,16 +144,23 @@ class Glove:
         fx = np.minimum((vals / self.x_max) ** self.alpha, 1.0) \
             .astype(np.float32)
         n = keys.shape[0]
-        order = np.arange(n)
-        for _ in range(self.epochs):
-            rng.shuffle(order)
-            for s in range(0, n, self.batch_size):
-                sl = order[s:s + self.batch_size]
-                (W, Wc, b, bc, hW, hWc, hb, hbc) = _glove_step(
-                    W, Wc, b, bc, hW, hWc, hb, hbc,
-                    jnp.asarray(keys[sl, 0]), jnp.asarray(keys[sl, 1]),
-                    jnp.asarray(logx[sl]), jnp.asarray(fx[sl]),
-                    jnp.float32(self.learning_rate), jnp.float32(1e-8))
+        B = min(self.batch_size, n)
+        # pad the triple list to a whole number of batches; fx=0 padding
+        # contributes zero gradient and zero AdaGrad accumulation
+        pad = (-n) % B
+        wi = jnp.asarray(np.concatenate([keys[:, 0],
+                                         np.zeros(pad, np.int32)]))
+        wj = jnp.asarray(np.concatenate([keys[:, 1],
+                                         np.zeros(pad, np.int32)]))
+        logx_d = jnp.asarray(np.concatenate([logx,
+                                             np.zeros(pad, np.float32)]))
+        fx_d = jnp.asarray(np.concatenate([fx, np.zeros(pad, np.float32)]))
+        for e in range(self.epochs):
+            (W, Wc, b, bc, hW, hWc, hb, hbc) = _glove_epoch(
+                W, Wc, b, bc, hW, hWc, hb, hbc, wi, wj, logx_d, fx_d,
+                jax.random.fold_in(jax.random.PRNGKey(self.seed), e),
+                jnp.float32(self.learning_rate), jnp.float32(1e-8),
+                batch=B)
         # final embedding = W + Wc (standard GloVe practice)
         self.syn0 = W + Wc
         return self
