@@ -14,7 +14,7 @@ img/s on the 2017 GPUs the reference targeted (K80/GTX1080 class) => target
 84 img/s. vs_baseline = measured / 84.0, i.e. 1.0 means the north star is
 met; >1 beats it.
 
-Usage: python bench.py [model]   (model: resnet50 | lenet | lstm |
+Usage: python bench.py [model]   (model: resnet50 | vgg16 | lenet | lstm |
 word2vec | doc2vec | attention | all; default all, headline = resnet50)
 """
 
@@ -119,17 +119,34 @@ def _steady_state_img_s(net, x, y, steps: int):
     return x.shape[0] / per_step
 
 
+def _imagenet_model_img_s(model_cls, *, batch, steps, seed,
+                          compute_dtype=None, image=224, labels=1000):
+    """Shared synthetic-ImageNet training bench for zoo CNNs."""
+    net = model_cls(num_labels=labels, dtype="float32",
+                    compute_dtype=compute_dtype).init()
+    rs = np.random.RandomState(seed)
+    x = rs.randn(batch, image, image, 3).astype(np.float32)
+    y = np.eye(labels, dtype=np.float32)[rs.randint(0, labels, batch)]
+    return _steady_state_img_s(net, x, y, steps)
+
+
 def bench_resnet50(batch: int = 64, steps: int = 20, image: int = 224,
                    compute_dtype=None):
     """ResNet50 training throughput, img/s (BASELINE config #2)."""
     from deeplearning4j_tpu.models import ResNet50
 
-    net = ResNet50(num_labels=1000, dtype="float32",
-                   compute_dtype=compute_dtype).init()
-    rs = np.random.RandomState(0)
-    x = rs.randn(batch, image, image, 3).astype(np.float32)
-    y = np.eye(1000, dtype=np.float32)[rs.randint(0, 1000, batch)]
-    return _steady_state_img_s(net, x, y, steps)
+    return _imagenet_model_img_s(ResNet50, batch=batch, steps=steps, seed=0,
+                                 compute_dtype=compute_dtype, image=image)
+
+
+def bench_vgg16(batch: int = 32, steps: int = 10):
+    """VGG16 training throughput, img/s (BASELINE config #5's model; the
+    ParallelWrapper half of that config needs >1 chip — its semantics are
+    covered by the multichip dryrun, the single-chip model cost here)."""
+    from deeplearning4j_tpu.models import VGG16
+
+    return _imagenet_model_img_s(VGG16, batch=batch, steps=steps, seed=4,
+                                 compute_dtype="bfloat16")
 
 
 def bench_lenet(batch: int = 512, steps: int = 40):
@@ -291,6 +308,7 @@ def bench_doc2vec(n_docs: int = 4000, epochs: int = 1):
 # bug, and publishing it poisons every number beside it. Refuse instead.
 SANITY_CEILING = {
     "lenet_mnist_img_s": 1e8,
+    "vgg16_bf16_img_s": 1e5,
     "textgen_lstm_tokens_s": 1e9,
     "word2vec_words_s": 1e8,
     "doc2vec_words_s": 1e8,
@@ -312,6 +330,7 @@ def _sane(name: str, value: float) -> float:
 # "unit" field when a sub-metric is run standalone
 METRIC_UNIT = {
     "lenet_mnist_img_s": "img/s",
+    "vgg16_bf16_img_s": "img/s",
     "textgen_lstm_tokens_s": "tokens/s",
     "word2vec_words_s": "words/s",
     "doc2vec_words_s": "words/s",
@@ -452,7 +471,7 @@ def _attention_bwd_long_metrics():
 
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
-    valid = ("all", "resnet50", "lenet", "lstm", "word2vec", "doc2vec",
+    valid = ("all", "resnet50", "vgg16", "lenet", "lstm", "word2vec", "doc2vec",
              "attention")
     if which not in valid:
         sys.exit(f"Unknown model '{which}'; choose one of {valid}")
@@ -475,6 +494,8 @@ def main():
             print(f"# resnet50 early probe FAILED: {e}", file=sys.stderr)
     if which in ("all", "lenet"):
         _sub_metric(extras, "lenet_mnist_img_s", bench_lenet)
+    if which in ("all", "vgg16"):
+        _sub_metric(extras, "vgg16_bf16_img_s", bench_vgg16)
     if which in ("all", "lstm"):
         _sub_metric(extras, "textgen_lstm_tokens_s", bench_lstm)
     if which in ("all", "word2vec"):
