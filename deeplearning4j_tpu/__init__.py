@@ -18,3 +18,30 @@ Package map (mirrors the reference's module inventory, SURVEY.md section 2):
 """
 
 __version__ = "0.1.0"
+
+
+def enable_compile_cache(directory: str,
+                         min_compile_secs: float = 0.5) -> None:
+    """Enable jax's persistent XLA compilation cache.
+
+    Through a remote-compile TPU backend a cold ResNet-class compile costs
+    tens of seconds per process; with the cache a second process reuses
+    the serialized executable (measured 13.7 s -> 2.4 s cold-to-first-
+    output for LeNet). Also honored automatically at import when the
+    ``DL4J_TPU_COMPILE_CACHE`` env var names a directory."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", directory)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      min_compile_secs)
+
+
+def _maybe_enable_cache_from_env() -> None:
+    import os
+
+    directory = os.environ.get("DL4J_TPU_COMPILE_CACHE")
+    if directory:
+        enable_compile_cache(directory)
+
+
+_maybe_enable_cache_from_env()
