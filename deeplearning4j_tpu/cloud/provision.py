@@ -17,6 +17,7 @@ commands run verbatim against a real project when ``dry_run=False``
 
 from __future__ import annotations
 
+import os
 import shlex
 import subprocess
 import time
@@ -108,9 +109,13 @@ class TpuVmProvisioner:
 
     def scp(self, name: str, local: str, remote: str,
             worker: str = "all") -> None:
-        """Push a file to pod workers (HostProvisioner.uploadFile)."""
+        """Push a file or directory to pod workers
+        (HostProvisioner.uploadFile). Directories (e.g. an unpacked
+        training package) need gcloud's --recurse flag or the copy fails
+        at runtime — a failure the dry-run argv tests cannot see."""
+        extra = ["--recurse"] if os.path.isdir(local) else []
         self.runner.run(
-            self._gcloud("scp", local, f"{name}:{remote}",
+            self._gcloud("scp", *extra, local, f"{name}:{remote}",
                          f"--worker={worker}"))
 
 

@@ -121,8 +121,10 @@ class MixtureOfExpertsLayer(FeedForwardLayer):
     def regularization_grad(self, params):
         out = super().regularization_grad(params)
         # closed form of the coef*sum(Wg^2) term above (no 0.5 factor,
-        # unlike the base l2 form)
-        if self.load_balance_coef:
+        # unlike the base l2 form). ``params`` may be a partial (even
+        # empty) subtree — layerwise pretraining passes only the
+        # pretrained layer's params through add_regularization_grads.
+        if self.load_balance_coef and "Wg" in params:
             g = 2.0 * self.load_balance_coef * params["Wg"]
             out["Wg"] = out.get("Wg", 0) + g
         return out

@@ -416,6 +416,11 @@ class MultiLayerNetwork:
                 return jnp.mean(per)
 
             loss, grads = jax.value_and_grad(loss_fn)(p_layer)
+            # regularization.py invariant: every jax.grad consumer adds the
+            # closed-form l1/l2 gradient (DL4J's BaseUpdater.postApply
+            # applies decay during layerwise pretraining too); layers
+            # outside p_layer contribute nothing
+            grads = add_regularization_grads(self, p_layer, grads)
             steps, new_opt = updater.step(grads, opt_state, iteration)
             new_p = jax.tree_util.tree_map(lambda p, s: p - s, p_layer, steps)
             return new_p, new_opt, loss

@@ -99,13 +99,21 @@ class CheckpointStore:
                         "coefficients.bin" not in names:
                     raise zipfile.BadZipFile("missing entries")
             return True
-        except (zipfile.BadZipFile, OSError) as e:
+        except zipfile.BadZipFile as e:
             quarantine = path + ".corrupt"
             warnings.warn(f"quarantining corrupt checkpoint {path}: {e}")
             try:
                 os.replace(path, quarantine)
             except OSError:
                 pass
+            return False
+        except OSError as e:
+            # A transient read failure (e.g. a concurrent _prune/os.replace
+            # from another process sharing the directory) is NOT evidence
+            # of corruption — skip the file this pass, never quarantine a
+            # possibly-good newest checkpoint on it.
+            warnings.warn(f"skipping unreadable checkpoint {path} "
+                          f"(transient?): {e}")
             return False
 
     # -------------------------------------------------------------- save
@@ -140,16 +148,47 @@ class CheckpointStore:
         return ckpts[-1] if ckpts else None
 
     def restore(self):
-        """(net, extra_meta) from the newest valid checkpoint, or None."""
-        path = self.latest()
-        if path is None:
-            return None
-        net = load_model(path)
-        meta = {}
-        with zipfile.ZipFile(path) as zf:
-            if _META_NAME in zf.namelist():
-                meta = json.loads(zf.read(_META_NAME).decode())
-        return net, meta
+        """(net, extra_meta) from the newest valid checkpoint, or None.
+
+        Falls back to the next-older checkpoint when the load itself
+        fails: a process sharing the directory can prune/replace a path
+        between ``checkpoints()`` validating it and the reopen here — the
+        same race ``_valid`` tolerates, so a crash instead of a fallback
+        would defeat that tolerance. The exclusion set is call-local: a
+        filename that fails THIS restore may be validly re-saved later
+        (save() reuses ``ckpt-{iteration}``), so it must not be
+        blacklisted for the store's lifetime."""
+        skip = set()
+        while True:
+            candidates = [p for p in self.checkpoints() if p not in skip]
+            if not candidates:
+                if skip:
+                    # every candidate failed to LOAD after passing
+                    # validation — that is a persistent format problem
+                    # (e.g. a zip missing load_model's required entries),
+                    # not the transient prune race. Returning None here
+                    # would silently discard the run's entire progress by
+                    # retraining from scratch.
+                    raise RuntimeError(
+                        "all checkpoints failed to load after validating "
+                        f"({sorted(skip)}) — refusing to silently restart "
+                        "from scratch; inspect or remove them to proceed")
+                return None
+            path = candidates[-1]
+            try:
+                net = load_model(path)
+                meta = {}
+                with zipfile.ZipFile(path) as zf:
+                    if _META_NAME in zf.namelist():
+                        meta = json.loads(zf.read(_META_NAME).decode())
+                return net, meta
+            except (OSError, zipfile.BadZipFile, KeyError) as e:
+                # the reopened file can fail differently than _valid saw it:
+                # truncation mid-read raises BadZipFile, a half-replaced
+                # archive raises KeyError from load_model's zf.read
+                warnings.warn(f"checkpoint {path} vanished/unreadable "
+                              f"during restore ({e}); trying next-older")
+                skip.add(path)
 
 
 class CheckpointListener(TrainingListener):
@@ -214,6 +253,18 @@ class FaultTolerantTrainer:
                 self._batch_in_epoch += 1
                 if net.iteration % self.frequency == 0:
                     self.store.save(net, self._meta())
+            if skip_batches > 0:
+                # the resumed stream produced fewer batches this epoch than
+                # when the checkpoint was written — the iterator_factory
+                # determinism contract is violated; without this warning the
+                # leftover skips silently swallow head batches of the NEXT
+                # epoch
+                warnings.warn(
+                    f"resume skip position exceeded epoch {epoch} length by "
+                    f"{skip_batches} batches — the iterator_factory is not "
+                    "producing the same stream it did when the checkpoint "
+                    "was written; dropping the leftover skips")
+                skip_batches = 0
             for listener in net.listeners:
                 listener.on_epoch_end(net)
         net.epoch = epochs

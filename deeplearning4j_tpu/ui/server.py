@@ -367,6 +367,10 @@ class UIServer:
             raise ValueError(
                 f"labels length {len(labels)} != points length {len(points)}")
         pts = [[float(p[0]), float(p[1])] for p in points]
+        # eviction below is least-recently-UPDATED: re-uploading an
+        # existing session must refresh its position, or the actively
+        # updated session gets evicted while stale ones survive
+        self._tsne.pop(str(session_id), None)
         self._tsne[str(session_id)] = {
             "points": pts,
             "labels": [str(l) for l in labels] if labels is not None

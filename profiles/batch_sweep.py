@@ -1,0 +1,128 @@
+"""Round-5 batch sweep: ResNet50 + VGG16 bf16 throughput vs batch size.
+
+VERDICT r4 weak #2/#3: batch 64 (ResNet50) and batch 32 (VGG16) were never
+swept upward; the unclaimed MFU lives there. The tunneled chip's throughput
+swings ~3.5x on a minutes timescale (profiles/README.md variance table), so
+a naive A-then-B sweep measures contention, not batch effects. This sweep
+INTERLEAVES: each round measures every config once, and configs are compared
+within-round (plus median across rounds).
+
+Usage: python profiles/batch_sweep.py [rounds]
+Results land in profiles/chip_session_results.json under "batch_sweep_r5"
+(replacing any previous sweep under that key; other keys are preserved).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RESNET_FLOP_PER_IMG = 12.8e9   # profiles/README.md FLOP audit (train step)
+VGG16_FLOP_PER_IMG = 23.3e9    # 3x fwd 7.75 GFLOP (MAC=2) at 224^2
+PEAK_BF16_FLOPS = 197e12       # v5e
+
+
+def _prepare(model_cls, batch, seed, image=224, labels=1000):
+    """Build net + device data + compiled step; return a sampler closure."""
+    import bench
+    import jax
+    import jax.numpy as jnp
+
+    net = model_cls(num_labels=labels, dtype="float32",
+                    compute_dtype="bfloat16").init()
+    rs = np.random.RandomState(seed)
+    x = rs.randn(batch, image, image, 3).astype(np.float32)
+    y = np.eye(labels, dtype=np.float32)[rs.randint(0, labels, batch)]
+    xd, yd = jnp.asarray(x), jnp.asarray(y)
+    key = (xd.shape, yd.shape, False, False, False)
+    step = net._get_step(key)
+    rng = jax.random.PRNGKey(0)
+    tree0 = jax.tree_util.tree_map(
+        lambda a: a.copy(), (net.params, net.updater_state, net.state))
+
+    def run(n):
+        params, opt, state = jax.tree_util.tree_map(
+            lambda a: a.copy(), tree0)
+        bench._sync(params)
+        t0 = time.perf_counter()
+        for i in range(n):
+            params, opt, state, _, loss = step(
+                params, opt, state, rng, jnp.float32(i + 1), xd, yd, None,
+                None, {})
+        bench._sync(params)
+        return time.perf_counter() - t0
+
+    run(1)  # compile + warm
+
+    def sample(steps=10):
+        t1 = run(steps)
+        t2 = run(2 * steps)
+        dt = t2 - t1
+        if dt < bench.MIN_MARGINAL_WINDOW_S:
+            return None
+        return batch / (dt / steps)
+
+    return sample
+
+
+def main(rounds=3):
+    from deeplearning4j_tpu.models import VGG16, ResNet50
+
+    configs = []
+    for b in (64, 128, 256):
+        configs.append((f"resnet50_b{b}", ResNet50, b, RESNET_FLOP_PER_IMG))
+    for b in (32, 64, 128, 192):
+        configs.append((f"vgg16_b{b}", VGG16, b, VGG16_FLOP_PER_IMG))
+
+    samplers = {}
+    for name, cls, b, _ in configs:
+        try:
+            t0 = time.time()
+            samplers[name] = _prepare(cls, b, seed=b)
+            print(f"# prepared {name} ({time.time() - t0:.0f}s)", flush=True)
+        except Exception as e:  # noqa: BLE001 — OOM at big batch is data
+            print(f"# {name} PREP FAILED: {type(e).__name__}: "
+                  f"{str(e)[:200]}", flush=True)
+
+    results = {name: [] for name in samplers}
+    for r in range(rounds):
+        for name, s in samplers.items():
+            try:
+                v = s()
+                if v is not None:
+                    results[name].append(round(v))
+                print(f"# round {r} {name}: {v and round(v)} img/s",
+                      flush=True)
+            except Exception as e:  # noqa: BLE001
+                print(f"# round {r} {name} FAILED: {e}", flush=True)
+
+    summary = {}
+    for name, _, b, flop in configs:
+        if results.get(name):
+            med = float(np.median(results[name]))
+            summary[name] = {
+                "windows_img_s": results[name],
+                "median_img_s": round(med),
+                "mfu_pct": round(100 * med * flop / PEAK_BF16_FLOPS, 1),
+            }
+    print(json.dumps(summary, indent=1), flush=True)
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "chip_session_results.json")
+    merged = {}
+    if os.path.exists(path):
+        with open(path) as fh:
+            merged = json.load(fh)
+    merged["batch_sweep_r5"] = summary
+    with open(path, "w") as fh:
+        json.dump(merged, fh, indent=1)
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 3)

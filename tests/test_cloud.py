@@ -41,6 +41,19 @@ class TestTpuVmProvisioner:
         ssh, scp = r.history
         assert "--worker=all" in ssh and "--command=hostname" in ssh
         assert "pod1:~/wheel.whl" in scp
+        assert "--recurse" not in scp  # plain file: no recursive copy
+
+    def test_scp_directory_adds_recurse(self, tmp_path):
+        # a directory package (ClusterSetup pushes "the training package")
+        # needs gcloud's --recurse or the copy fails at runtime
+        r = CommandRunner(dry_run=True)
+        tpus = TpuVmProvisioner("p", "z", r)
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        tpus.scp("pod1", str(pkg), "~/pkg")
+        (scp,) = r.history
+        assert "--recurse" in scp
+        assert scp.index("--recurse") < scp.index(str(pkg))
 
 
 class TestGcsTransfer:

@@ -88,6 +88,82 @@ class TestCheckpointStore:
         restored, _ = store.restore()
         assert restored.iteration == 1
 
+    def test_transient_oserror_does_not_quarantine(self, tmp_path,
+                                                   monkeypatch):
+        """An OSError while validating (concurrent prune/replace from a
+        sharing process) must skip the file this pass, not rename a
+        possibly-good checkpoint to .corrupt."""
+        import zipfile as _zf
+
+        store = CheckpointStore(str(tmp_path), keep=5)
+        net = _net()
+        net.fit(_batches(1)[0])
+        path = store.save(net)
+        real_zip = _zf.ZipFile
+
+        def flaky_zip(p, *a, **kw):
+            raise OSError("transient read failure")
+
+        monkeypatch.setattr(_zf, "ZipFile", flaky_zip)
+        with pytest.warns(UserWarning, match="transient"):
+            assert store.latest() is None  # skipped this pass
+        monkeypatch.setattr(_zf, "ZipFile", real_zip)
+        assert not os.path.exists(path + ".corrupt")
+        assert store.latest() == path  # still valid next pass
+
+    def test_restore_falls_back_when_newest_vanishes_midread(
+            self, tmp_path, monkeypatch):
+        """A sharing process can prune a checkpoint between validation and
+        the reopen inside restore(): fall back to next-older, and do NOT
+        blacklist the filename for the store's lifetime (save() legally
+        reuses it after resuming)."""
+        import deeplearning4j_tpu.parallel.elastic as el
+
+        store = CheckpointStore(str(tmp_path), keep=5)
+        net = _net()
+        ds = _batches(1)[0]
+        net.fit(ds)
+        p1 = store.save(net)
+        net.fit(ds)
+        p2 = store.save(net)
+        real = el.load_model
+
+        def racy(path):
+            if path == p2:
+                os.unlink(p2)  # the concurrent pruner strikes mid-read
+                raise OSError("gone")
+            return real(path)
+
+        monkeypatch.setattr(el, "load_model", racy)
+        with pytest.warns(UserWarning, match="trying next-older"):
+            restored, _ = store.restore()
+        monkeypatch.setattr(el, "load_model", real)
+        assert restored.iteration == 1  # fell back to p1
+        restored.fit(ds)
+        assert store.save(restored) == p2  # same filename re-saved...
+        r2, _ = store.restore()
+        assert r2.iteration == 2           # ...and restorable again
+
+    def test_restore_raises_when_all_checkpoints_unloadable(
+            self, tmp_path, monkeypatch):
+        """If EVERY validated checkpoint fails to load (persistent format
+        problem, not the transient race), restore must raise rather than
+        silently restart the run from scratch."""
+        import deeplearning4j_tpu.parallel.elastic as el
+
+        store = CheckpointStore(str(tmp_path))
+        net = _net()
+        net.fit(_batches(1)[0])
+        store.save(net)
+
+        def broken(path):
+            raise KeyError("metadata.json")
+
+        monkeypatch.setattr(el, "load_model", broken)
+        with pytest.warns(UserWarning), \
+                pytest.raises(RuntimeError, match="refusing to silently"):
+            store.restore()
+
     def test_atomic_save_never_leaves_partial(self, tmp_path):
         store = CheckpointStore(str(tmp_path))
         net = _net()
@@ -140,6 +216,20 @@ class TestFaultTolerantTrainer:
         np.testing.assert_allclose(
             np.asarray(final.params_flat(), np.float32),
             np.asarray(base.params_flat(), np.float32), rtol=0, atol=0)
+
+    def test_skip_spill_into_next_epoch_warns(self, tmp_path):
+        """A resumed stream shorter than at checkpoint time (violated
+        iterator_factory determinism) must warn and drop leftover skips
+        instead of silently swallowing head batches of later epochs."""
+        store = CheckpointStore(str(tmp_path))
+        trainer = FaultTolerantTrainer(_net(), store, frequency=100)
+        batches = _batches(2)
+        factory = lambda: ListDataSetIterator(list(batches), batch_size=16)
+        with pytest.warns(UserWarning, match="iterator_factory"):
+            # skip_batches=3 > 2 batches/epoch: spills into epoch 2
+            trainer.fit(factory, epochs=2, skip_batches=3)
+        # epoch 2 trained ALL its batches (skips dropped, not spilled)
+        assert trainer.net.iteration == 2
 
     def test_completed_run_not_retrained(self, tmp_path):
         batches = _batches(3)

@@ -171,6 +171,40 @@ class TestPretraining:
         assert check_gradients(net, x, y)
 
 
+class TestPretrainRegularization:
+    def test_pretrain_applies_weight_decay(self):
+        """regularization.py invariant: the pretrain gradient path applies
+        l1/l2 like every other jax.grad consumer (DL4J's
+        BaseUpdater.postApply decays during pretraining too). With a large
+        l2, pretrained weights must end up smaller than without it."""
+        rs = np.random.RandomState(0)
+        x = (rs.rand(64, 12) > 0.5).astype(np.float32)
+
+        def norm_after_pretrain(l2):
+            conf = (NeuralNetConfiguration.builder().seed(1)
+                    .updater(Sgd(learning_rate=0.05)).l2(l2)
+                    .list(RBM(n_out=8),
+                          OutputLayer(n_out=2, activation="softmax",
+                                      loss="mcxent"))
+                    .set_input_type(InputType.feed_forward(12)).build())
+            net = MultiLayerNetwork(conf).init()
+            net._pretrain_layer(0, [DataSet(x, None)] * 30, 1)
+            return float(np.linalg.norm(np.asarray(net.params["0"]["W"])))
+
+        assert norm_after_pretrain(0.5) < norm_after_pretrain(0.0)
+
+    def test_moe_regularization_grad_tolerates_partial_params(self):
+        """add_regularization_grads walks ALL layers with whatever subtree
+        the gradient path holds — during layerwise pretraining that is an
+        EMPTY dict for every other layer. MoE's extra load-balance term
+        (keyed on 'Wg') must not KeyError on it."""
+        from deeplearning4j_tpu.nn.conf.layers import MixtureOfExpertsLayer
+
+        moe = MixtureOfExpertsLayer(n_in=6, n_out=8, n_experts=2, top_k=1,
+                                    expert_hidden=4, load_balance_coef=0.1)
+        assert moe.regularization_grad({}) == {}
+
+
 class TestSerde:
     def test_json_round_trip(self):
         lyr = RBM(n_in=6, n_out=4, hidden_unit="rectified",

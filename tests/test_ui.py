@@ -215,6 +215,22 @@ class TestUIServer:
         finally:
             server.stop()
 
+    def test_tsne_eviction_is_least_recently_updated(self):
+        """Re-uploading a session refreshes its eviction position: the
+        actively updated session must survive while stale ones go."""
+        server = UIServer(port=0)
+        old_max = server.TSNE_MAX_SESSIONS
+        server.TSNE_MAX_SESSIONS = 3
+        try:
+            pts = [[0.0, 0.0]]
+            for sid in ("a", "b", "c"):
+                server.upload_tsne(sid, pts)
+            server.upload_tsne("a", pts)   # refresh "a": now newest
+            server.upload_tsne("d", pts)   # evicts "b", NOT "a"
+            assert set(server._tsne) == {"a", "c", "d"}
+        finally:
+            server.TSNE_MAX_SESSIONS = old_max
+
     def test_tsne_from_plot_module(self):
         """End-to-end: plot.Tsne output feeds upload_tsne directly."""
         from deeplearning4j_tpu.plot import Tsne
