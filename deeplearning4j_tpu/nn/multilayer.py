@@ -28,7 +28,8 @@ import numpy as np
 from deeplearning4j_tpu.nn.conf.builders import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.conf.layers.misc import CenterLossOutputLayer
 from deeplearning4j_tpu.nn.conf.preprocessors import preprocessor_key
-from deeplearning4j_tpu.nn.regularization import add_regularization_grads
+from deeplearning4j_tpu.nn.regularization import (add_regularization_grads,
+                                                  penalty_value)
 from deeplearning4j_tpu.nn.gradient_normalization import (
     apply_gradient_normalization,
     layer_map_for,
@@ -167,14 +168,13 @@ class MultiLayerNetwork:
             data_loss = jnp.sum(per_ex * lm) / jnp.maximum(jnp.sum(lm), 1.0)
         else:
             data_loss = jnp.mean(per_ex)
-        reg = 0.0
-        for i, layer in enumerate(self.layers):
-            reg = reg + layer.regularization(params[str(i)])
         # the penalty VALUE stays in the reported score (reference:
         # computeScore adds fullNetworkL1+L2) but is not differentiated —
         # the train step adds the closed-form regularization_grad instead
         # (autodiff through these reductions measured 30% of the ResNet50
-        # step, profiles/README.md)
+        # step, profiles/README.md); computed fused, not per-tensor
+        # (per-tensor micro-reductions measured 43% of the bf16 step)
+        reg = penalty_value(self, params)
         if not isinstance(reg, float):
             reg = jax.lax.stop_gradient(reg)
         new_states[str(out_idx)] = state.get(str(out_idx), {})

@@ -23,52 +23,23 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-RESNET_FLOP_PER_IMG = 12.8e9   # profiles/README.md FLOP audit (train step)
-VGG16_FLOP_PER_IMG = 23.3e9    # 3x fwd 7.75 GFLOP (MAC=2) at 224^2
+# round-5 corrected audit (profiles/flop_audit.py): XLA-counted train-step
+# flops at multiply+add, the same convention as the peak figure
+RESNET_FLOP_PER_IMG = 6.6e9
+VGG16_FLOP_PER_IMG = 89.35e9
 PEAK_BF16_FLOPS = 197e12       # v5e
 
 
-def _prepare(model_cls, batch, seed, image=224, labels=1000):
-    """Build net + device data + compiled step; return a sampler closure."""
+def _prepare(model_cls, batch, seed):
+    """One bench-identical timer per config (the sweep must measure with
+    the SAME methodology the bench reports, or sweep-picked defaults and
+    bench numbers drift apart)."""
     import bench
-    import jax
-    import jax.numpy as jnp
 
-    net = model_cls(num_labels=labels, dtype="float32",
-                    compute_dtype="bfloat16").init()
-    rs = np.random.RandomState(seed)
-    x = rs.randn(batch, image, image, 3).astype(np.float32)
-    y = np.eye(labels, dtype=np.float32)[rs.randint(0, labels, batch)]
-    xd, yd = jnp.asarray(x), jnp.asarray(y)
-    key = (xd.shape, yd.shape, False, False, False)
-    step = net._get_step(key)
-    rng = jax.random.PRNGKey(0)
-    tree0 = jax.tree_util.tree_map(
-        lambda a: a.copy(), (net.params, net.updater_state, net.state))
-
-    def run(n):
-        params, opt, state = jax.tree_util.tree_map(
-            lambda a: a.copy(), tree0)
-        bench._sync(params)
-        t0 = time.perf_counter()
-        for i in range(n):
-            params, opt, state, _, loss = step(
-                params, opt, state, rng, jnp.float32(i + 1), xd, yd, None,
-                None, {})
-        bench._sync(params)
-        return time.perf_counter() - t0
-
-    run(1)  # compile + warm
-
-    def sample(steps=10):
-        t1 = run(steps)
-        t2 = run(2 * steps)
-        dt = t2 - t1
-        if dt < bench.MIN_MARGINAL_WINDOW_S:
-            return None
-        return batch / (dt / steps)
-
-    return sample
+    timer = bench._imagenet_model_timer(
+        model_cls, batch=batch, steps=10, seed=seed,
+        compute_dtype="bfloat16")
+    return timer.window
 
 
 def main(rounds=3):

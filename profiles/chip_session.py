@@ -86,11 +86,13 @@ def resnet_profile():
 
     out = {}
     with jax.profiler.trace("profiles/resnet50_bf16_trace"):
-        out["bf16_img_s"] = bench.bench_resnet50(compute_dtype="bfloat16")
-    print(f"# resnet50 bf16 (traced): {out['bf16_img_s']:.0f} img/s",
+        med, windows = bench.bench_resnet50(compute_dtype="bfloat16")
+    out["bf16_img_s"], out["bf16_windows"] = med, windows
+    print(f"# resnet50 bf16 (traced): {med:.0f} img/s median of {windows}",
           flush=True)
-    out["f32_img_s"] = bench.bench_resnet50()
-    print(f"# resnet50 f32: {out['f32_img_s']:.0f} img/s", flush=True)
+    med, windows = bench.bench_resnet50()
+    out["f32_img_s"], out["f32_windows"] = med, windows
+    print(f"# resnet50 f32: {med:.0f} img/s median of {windows}", flush=True)
     return out
 
 
