@@ -72,6 +72,87 @@ def native_available() -> bool:
     return _load() is not None
 
 
+# ---------------------------------------------------------------- skipgram
+_SG_SO = os.path.join(_DIR, "_skipgram.so")
+_SG_SRC = os.path.join(_DIR, "skipgram.c")
+_sg_lib = None
+_sg_tried = False
+
+
+def _load_skipgram():
+    global _sg_lib, _sg_tried
+    with _lock:
+        if _sg_tried:
+            return _sg_lib
+        _sg_tried = True
+        try:
+            stale = (not os.path.exists(_SG_SO)
+                     or os.path.getmtime(_SG_SO) < os.path.getmtime(_SG_SRC))
+        except OSError:
+            stale = not os.path.exists(_SG_SO)
+        if stale:
+            cc = (os.environ.get("CC") or shutil.which("cc")
+                  or shutil.which("gcc"))
+            if cc is None:
+                return None
+            try:
+                # -O3 -ffast-math: the dot/axpy inner loops vectorize;
+                # the reference's libnd4j kernel is likewise SIMD C++
+                subprocess.run([cc, "-O3", "-ffast-math", "-shared",
+                                "-fPIC", "-o", _SG_SO, _SG_SRC, "-lm"],
+                               check=True, capture_output=True, timeout=120)
+            except (subprocess.SubprocessError, OSError):
+                return None
+        try:
+            lib = ctypes.CDLL(_SG_SO)
+        except OSError:
+            return None
+        lib.skipgram_train.restype = ctypes.c_long
+        lib.skipgram_train.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_long, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_int), ctypes.c_long,
+            ctypes.POINTER(ctypes.c_int), ctypes.c_long,
+            ctypes.c_int, ctypes.c_int,
+            ctypes.c_float, ctypes.c_float, ctypes.c_int,
+            ctypes.c_ulonglong]
+        _sg_lib = lib
+        return _sg_lib
+
+
+def skipgram_native_available() -> bool:
+    return _load_skipgram() is not None
+
+
+def skipgram_train(syn0, syn1neg, corpus, table, *, window: int,
+                   negative: int, alpha: float, min_alpha: float,
+                   epochs: int = 1, seed: int = 1):
+    """In-place native skip-gram NS training (the AggregateSkipGram hot
+    loop, SkipGram.java:215-272 / its libnd4j kernel). ``syn0``/``syn1neg``
+    are float32 C-contiguous [vocab, layer]; ``corpus`` int32 word indices
+    with -1 sentence separators; ``table`` int32 unigram^0.75 sampling
+    table. Returns trained pair count, or None when native is
+    unavailable (callers use the device path)."""
+    lib = _load_skipgram()
+    if lib is None:
+        return None
+    syn0 = np.ascontiguousarray(syn0, np.float32)
+    syn1neg = np.ascontiguousarray(syn1neg, np.float32)
+    corpus = np.ascontiguousarray(corpus, np.int32)
+    table = np.ascontiguousarray(table, np.int32)
+    fp = ctypes.POINTER(ctypes.c_float)
+    ip = ctypes.POINTER(ctypes.c_int)
+    pairs = lib.skipgram_train(
+        syn0.ctypes.data_as(fp), syn1neg.ctypes.data_as(fp),
+        syn0.shape[0], syn0.shape[1],
+        corpus.ctypes.data_as(ip), len(corpus),
+        table.ctypes.data_as(ip), len(table),
+        window, negative, alpha, min_alpha, epochs, seed)
+    if pairs < 0:
+        return None
+    return pairs, syn0, syn1neg
+
+
 def parse_numeric_csv(path: str, delimiter: str = ",",
                       skip_lines: int = 0):
     """Parse a purely numeric CSV file natively -> float64 [rows, cols],

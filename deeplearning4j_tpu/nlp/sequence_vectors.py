@@ -43,7 +43,9 @@ class SequenceVectors:
                  use_hierarchic_softmax: bool = True, sampling: float = 0.0,
                  batch_size: int = 512, seed: int = 12345,
                  elements_algorithm: str = "skipgram",
-                 tokenizer_factory=None):
+                 tokenizer_factory=None, backend: str = "auto"):
+        if backend not in ("auto", "device", "native"):
+            raise ValueError(f"Unknown backend '{backend}'")
         self.layer_size = layer_size
         self.window = window
         self.min_word_frequency = min_word_frequency
@@ -61,6 +63,7 @@ class SequenceVectors:
         self.elements_algorithm = elements_algorithm.lower()
         self.tokenizer_factory = tokenizer_factory or \
             DefaultTokenizerFactory()
+        self.backend = backend
         self.vocab: Optional[AbstractCache] = None
         self.syn0 = None
         self.syn1 = None
@@ -76,13 +79,23 @@ class SequenceVectors:
 
     def reset_weights(self) -> None:
         """syn0 ~ U(-0.5/D, 0.5/D), syn1/syn1neg zeros (reference:
-        InMemoryLookupTable.resetWeights)."""
+        InMemoryLookupTable.resetWeights).
+
+        Tables start HOST-side when the native backend will train (a
+        device round-trip of the full tables through the TPU tunnel
+        measured ~40% of native-path fit time); jnp consumers (queries,
+        the device path, shard_embedding_tables) convert on demand."""
         V, D = self.vocab.num_words(), self.layer_size
         rng = np.random.RandomState(self.seed)
-        self.syn0 = jnp.asarray(
-            (rng.random_sample((V, D)) - 0.5) / D, jnp.float32)
-        self.syn1 = jnp.zeros((V, D), jnp.float32)
-        self.syn1neg = jnp.zeros((V, D), jnp.float32)
+        syn0 = ((rng.random_sample((V, D)) - 0.5) / D).astype(np.float32)
+        if self._native_eligible_config():
+            self.syn0 = syn0
+            self.syn1 = np.zeros((V, D), np.float32)
+            self.syn1neg = np.zeros((V, D), np.float32)
+        else:
+            self.syn0 = jnp.asarray(syn0)
+            self.syn1 = jnp.zeros((V, D), jnp.float32)
+            self.syn1neg = jnp.zeros((V, D), jnp.float32)
         self._builder = BatchBuilder(
             self.vocab, window=self.window, negative=self.negative,
             use_hs=self.use_hs, sampling=self.sampling, seed=self.seed)
@@ -103,7 +116,90 @@ class SequenceVectors:
         if self.elements_algorithm not in ("skipgram", "cbow"):
             raise ValueError("Unknown elements algorithm "
                              f"'{self.elements_algorithm}'")
+        if self._use_native_backend():
+            return self._fit_native(sentences)
         return self._fit_element_epochs(sentences)
+
+    def _use_native_backend(self) -> bool:
+        """Route eligible configs to the native C hot loop — the
+        reference's own architecture (SkipGram.java's hot op is a native
+        libnd4j kernel, not JVM code): plain negative-sampling skip-gram
+        is a scatter-bound workload a CPU inner loop beats the device
+        scatter path at (measured 210k vs 184k words/s on the bench
+        config, profiles/w2v_baseline.py). The device path keeps every
+        other case: CBOW, hierarchic softmax, subsampling, and SHARDED
+        embedding tables (nlp/distributed.py EP training), which the
+        host loop cannot see."""
+        from deeplearning4j_tpu.native import skipgram_native_available
+
+        if self.backend == "device":
+            return False
+        sh = getattr(self.syn0, "sharding", None)
+        unsharded = sh is None or len(sh.device_set) <= 1
+        eligible = self._native_eligible_config() and unsharded
+        if self.backend == "native":
+            if not eligible:
+                raise ValueError(
+                    "backend='native' supports plain negative-sampling "
+                    "skip-gram on unsharded tables only (no HS, no "
+                    "subsampling, no CBOW), and needs the C toolchain")
+            return True
+        return eligible
+
+    def _native_eligible_config(self) -> bool:
+        """Config-level (pre-array) native-backend eligibility.
+        layer_size is part of it: the C kernel's accumulator is a fixed
+        4096-float buffer (native/skipgram.c) and a runtime rejection
+        there would otherwise silently fall back AFTER consuming a
+        possibly non-restartable sentence stream."""
+        from deeplearning4j_tpu.native import skipgram_native_available
+
+        return (self.backend != "device"
+                and self.elements_algorithm == "skipgram"
+                and not self.use_hs and self.negative > 0
+                and self.sampling == 0.0
+                and self.layer_size <= 4096
+                and skipgram_native_available())
+
+    def _fit_native(self, sentences) -> "SequenceVectors":
+        """Train via native/skipgram.c in place of the jitted epoch."""
+        from deeplearning4j_tpu.native import skipgram_train
+
+        if hasattr(sentences, "reset"):
+            sentences.reset()
+        cache = self.vocab
+        corpus = []
+        for sentence in sentences:
+            tokens = self.tokenizer_factory.create(sentence).tokens() \
+                if isinstance(sentence, str) else list(sentence)
+            any_tok = False
+            for tok in tokens:
+                i = cache.index_of(tok)
+                if i >= 0:
+                    corpus.append(i)
+                    any_tok = True
+            if any_tok:
+                corpus.append(-1)
+        if not corpus:
+            return self
+        counts = cache.counts_array()
+        p = counts ** 0.75
+        p /= p.sum()
+        table = np.repeat(np.arange(len(p), dtype=np.int32),
+                          np.maximum(1, (p * 1_000_000).astype(np.int64)))
+        # host tables train in place; a device-resident table is pulled
+        # once (and stays host-side after — queries convert on demand)
+        syn0 = np.ascontiguousarray(np.asarray(self.syn0), np.float32)
+        syn1neg = np.ascontiguousarray(np.asarray(self.syn1neg), np.float32)
+        out = skipgram_train(
+            syn0, syn1neg, np.asarray(corpus, np.int32), table,
+            window=self.window, negative=self.negative,
+            alpha=self.learning_rate, min_alpha=self.min_learning_rate,
+            epochs=self.epochs * self.iterations, seed=self.seed or 1)
+        if out is None:  # toolchain raced away: device fallback
+            return self._fit_element_epochs(sentences)
+        _, self.syn0, self.syn1neg = out
+        return self
 
     def _fit_element_epochs(self, sentences) -> "SequenceVectors":
         """Device-resident skipgram/CBOW training, transfer-minimal: the host

@@ -1,0 +1,100 @@
+"""Measured local baseline for the reference's Word2Vec rate (VERDICT r4
+weak #6 / next-step #5): the reference's skip-gram hot op is a native
+libnd4j kernel (SkipGram.java:215-272 dispatches AggregateSkipGram); the
+stand-in is the same inner loop in C (native/skipgram.c), -O3, run on
+this host's CPU over the EXACT bench corpus/config (1M words, 30k vocab,
+layer 128, window 5, negative 5 — bench.bench_word2vec). nproc=1 in this
+image, so the reference's multi-thread fan-out adds nothing here; the
+single-thread rate IS the host ceiling.
+
+Usage: python profiles/w2v_baseline.py
+Merges {"w2v_native_baseline": {...}} into chip_session_results.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    from deeplearning4j_tpu.native import (
+        skipgram_native_available,
+        skipgram_train,
+    )
+    from deeplearning4j_tpu.nlp import Word2Vec
+
+    assert skipgram_native_available(), "C toolchain missing"
+
+    # the bench corpus, verbatim (bench.bench_word2vec)
+    n_sentences = 50000
+    rs = np.random.RandomState(3)
+    vocab = [f"w{i}" for i in range(30000)]
+    zipf = np.minimum(rs.zipf(1.3, size=n_sentences * 20) - 1,
+                      len(vocab) - 1)
+    sentences = [" ".join(vocab[z] for z in zipf[i * 20:(i + 1) * 20])
+                 for i in range(n_sentences)]
+
+    # build the same vocab/filtering the device path trains with
+    w2v = Word2Vec(layer_size=128, window=5, min_word_frequency=2,
+                   negative=5, use_hierarchic_softmax=False, epochs=1,
+                   batch_size=8192)
+    w2v.build_vocab(sentences)
+    w2v.reset_weights()
+    cache = w2v.vocab
+    corpus = []
+    for s in sentences:
+        for tok in s.split():
+            i = cache.index_of(tok)
+            if i >= 0:
+                corpus.append(i)
+        corpus.append(-1)
+    corpus = np.asarray(corpus, np.int32)
+    n_words = int((corpus >= 0).sum())
+
+    # unigram^0.75 table, classic size
+    counts = cache.counts_array()
+    p = counts ** 0.75
+    p /= p.sum()
+    table = np.repeat(np.arange(len(p), dtype=np.int32),
+                      np.maximum(1, (p * 1_000_000).astype(np.int64)))
+
+    syn0 = np.asarray(w2v.syn0, np.float32).copy()
+    syn1 = np.asarray(w2v.syn1neg, np.float32).copy()
+
+    t0 = time.perf_counter()
+    pairs, syn0, syn1 = skipgram_train(
+        syn0, syn1, corpus, table, window=5, negative=5,
+        alpha=0.025, min_alpha=1e-4, epochs=1, seed=7)
+    dt = time.perf_counter() - t0
+    rate = n_words / dt
+    out = {
+        "native_words_s": round(rate),
+        "trained_pairs": int(pairs),
+        "corpus_words": n_words,
+        "seconds": round(dt, 2),
+        "threads": 1,
+        "note": "C -O3 AggregateSkipGram stand-in, bench corpus/config, "
+                "single core (nproc=1 on this image)",
+    }
+    print(json.dumps(out), flush=True)
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "chip_session_results.json")
+    merged = {}
+    if os.path.exists(path):
+        with open(path) as fh:
+            merged = json.load(fh)
+    merged["w2v_native_baseline"] = out
+    with open(path, "w") as fh:
+        json.dump(merged, fh, indent=1)
+
+
+if __name__ == "__main__":
+    main()

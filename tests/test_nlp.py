@@ -111,6 +111,52 @@ class TestWord2Vec:
         assert w2v.similarity("night", "moon") > \
             w2v.similarity("night", "sun")
 
+    def test_native_backend_learns_topic_structure(self):
+        """The native C hot loop (native/skipgram.c — the reference's
+        AggregateSkipGram stand-in, SkipGram.java:215-272) trains real
+        embeddings; backend='native' forces it."""
+        from deeplearning4j_tpu.native import skipgram_native_available
+
+        if not skipgram_native_available():
+            pytest.skip("no C toolchain")
+        corpus = _synthetic_corpus()
+        w2v = Word2Vec(layer_size=32, window=4, min_word_frequency=3,
+                       epochs=4, use_hierarchic_softmax=False, negative=5,
+                       learning_rate=0.05, seed=7, backend="native")
+        w2v.fit(CollectionSentenceIterator(corpus))
+        assert w2v.similarity("day", "sun") > w2v.similarity("day", "moon")
+        assert w2v.similarity("night", "moon") > \
+            w2v.similarity("night", "sun")
+
+    def test_native_backend_routing_rules(self):
+        """auto: plain NS skip-gram routes native; HS / CBOW / device pin
+        stay on the device path; native pin on an ineligible config
+        raises instead of silently training differently."""
+        from deeplearning4j_tpu.native import skipgram_native_available
+
+        if not skipgram_native_available():
+            pytest.skip("no C toolchain")
+        corpus = _synthetic_corpus(60)
+
+        def built(**kw):
+            w2v = Word2Vec(layer_size=8, window=2, min_word_frequency=1,
+                           **kw)
+            w2v.build_vocab(corpus)
+            w2v.reset_weights()
+            return w2v
+
+        assert built(negative=5, use_hierarchic_softmax=False
+                     )._use_native_backend()
+        assert not built(negative=5, use_hierarchic_softmax=True
+                         )._use_native_backend()
+        assert not built(negative=5, use_hierarchic_softmax=False,
+                         backend="device")._use_native_backend()
+        assert not built(negative=5, use_hierarchic_softmax=False,
+                         elements_algorithm="cbow")._use_native_backend()
+        with pytest.raises(ValueError, match="native"):
+            built(negative=0, use_hierarchic_softmax=True,
+                  backend="native")._use_native_backend()
+
     def test_cbow_learns_topic_structure(self):
         corpus = _synthetic_corpus()
         w2v = Word2Vec(layer_size=32, window=4, min_word_frequency=3,
@@ -389,9 +435,13 @@ class TestDistributedEmbeddings:
         sents = self._corpus()
 
         def train(sharded):
+            # backend pinned: the parity under test is a DEVICE-path
+            # property (sharding must not change the math); auto would
+            # route the unsharded run to the native C loop instead
             w2v = Word2Vec(layer_size=16, window=3, min_word_frequency=2,
                            negative=5, use_hierarchic_softmax=False,
-                           epochs=2, learning_rate=0.05, seed=11)
+                           epochs=2, learning_rate=0.05, seed=11,
+                           backend="device")
             w2v.build_vocab(sents)
             w2v.reset_weights()
             if sharded:
