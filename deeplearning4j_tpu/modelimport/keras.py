@@ -44,6 +44,10 @@ from deeplearning4j_tpu.nn.conf.layers.core import (
     EmbeddingLayer,
     OutputLayer,
 )
+from deeplearning4j_tpu.nn.conf.graph_conf import (
+    ElementWiseVertex,
+    MergeVertex,
+)
 from deeplearning4j_tpu.nn.conf.layers.normalization import BatchNormalization
 from deeplearning4j_tpu.nn.conf.layers.pooling import GlobalPoolingLayer
 from deeplearning4j_tpu.nn.conf.layers.recurrent import LSTM, RnnOutputLayer
@@ -184,9 +188,12 @@ def _model_config(f) -> dict:
 
 
 def import_keras_model_and_weights(path: str):
-    """Functional or Sequential model import (linear Functional graphs are
-    imported as sequential stacks; reference: KerasModelImport
-    .importKerasModelAndWeights)."""
+    """Functional or Sequential model import. Sequential (and LINEAR
+    functional) models become a MultiLayerNetwork; BRANCHED functional
+    DAGs (residual adds, concat merges — the zoo-class models) become a
+    ComputationGraph (reference: KerasModel.java:419-495 builds a
+    ComputationGraphConfiguration.GraphBuilder; merge layers via
+    layers/KerasMerge.java)."""
     import h5py
 
     with h5py.File(path, "r") as f:
@@ -195,10 +202,226 @@ def import_keras_model_and_weights(path: str):
         return import_keras_sequential_model_and_weights(path)
     layers = config["config"]["layers"] \
         if isinstance(config["config"], dict) else config["config"]
-    # accept linear chains only (single input, each layer feeds the next)
-    seq_layers = [l for l in layers if l["class_name"] != "InputLayer"]
-    fake = {"class_name": "Sequential", "config": seq_layers}
-    return _import_sequential(path, fake)
+    if _is_linear(layers):
+        # linear chains keep the (simpler, flat-indexed) sequential path;
+        # the InputLayer stays in the list — it contributes no layer but
+        # carries the input shape (Keras 3 puts batch_shape only there)
+        fake = {"class_name": "Sequential", "config": list(layers)}
+        return _import_sequential(path, fake)
+    return _import_functional(path, config)
+
+
+def _inbound_names(layer: dict):
+    """Input layer names of one functional-API layer, across config eras:
+    Keras 1/2 ``[[["name", node, tensor], ...]]`` and Keras 3 legacy-h5
+    ``[{"args": [__keras_tensor__...]}]`` (keras_history carries the
+    producing layer name)."""
+    nodes = layer.get("inbound_nodes") or []
+    if not nodes:
+        return []
+    if len(nodes) > 1:
+        raise ValueError(
+            f"Layer '{layer.get('name')}' is shared (has "
+            f"{len(nodes)} inbound nodes) — shared-layer reuse is not "
+            "supported (the reference rejects these too)")
+    node = nodes[0]
+    names = []
+    if isinstance(node, dict):  # Keras 3
+        def collect(obj):
+            if isinstance(obj, dict):
+                if obj.get("class_name") == "__keras_tensor__":
+                    names.append(obj["config"]["keras_history"][0])
+                else:
+                    for v in obj.values():
+                        collect(v)
+            elif isinstance(obj, (list, tuple)):
+                for v in obj:
+                    collect(v)
+        collect(node.get("args", []))
+        collect(node.get("kwargs", {}))
+    else:  # Keras 1/2: list of [name, node_index, tensor_index, (kwargs)]
+        for ref in node:
+            names.append(ref[0] if isinstance(ref, (list, tuple)) else ref)
+    return names
+
+
+def _is_linear(layers) -> bool:
+    """True when every non-input layer has exactly one distinct input and
+    nothing branches (each producer feeds at most one consumer)."""
+    consumers: dict = {}
+    for l in layers:
+        if l["class_name"] == "InputLayer":
+            continue
+        try:
+            ins = set(_inbound_names(l))
+        except ValueError:
+            return False
+        if len(ins) > 1:
+            return False
+        for i in ins:
+            consumers[i] = consumers.get(i, 0) + 1
+    return all(c <= 1 for c in consumers.values())
+
+
+# Keras merge-layer class -> (vertex factory). Concatenate merges along
+# the feature axis (our MergeVertex); the rest are pointwise
+# (ElementWiseVertex ops) — reference: layers/KerasMerge.java
+_MERGE_CLASSES = {
+    "Add": lambda c: ElementWiseVertex(op="add"),
+    "Subtract": lambda c: ElementWiseVertex(op="subtract"),
+    "Multiply": lambda c: ElementWiseVertex(op="product"),
+    "Average": lambda c: ElementWiseVertex(op="average"),
+    "Maximum": lambda c: ElementWiseVertex(op="max"),
+    "Concatenate": lambda c: MergeVertex(),
+}
+_MERGE_MODES = {  # Keras-1 Merge(mode=...)
+    "sum": "Add", "mul": "Multiply", "ave": "Average", "max": "Maximum",
+    "concat": "Concatenate",
+}
+
+
+def _inbound_rank(layer: dict):
+    """Tensor rank of the layer's inputs when the config records it
+    (Keras 3 keeps each __keras_tensor__'s shape); None otherwise."""
+    nodes = layer.get("inbound_nodes") or []
+    for node in nodes:
+        if not isinstance(node, dict):
+            continue
+        found = []
+
+        def collect(obj):
+            if isinstance(obj, dict):
+                if obj.get("class_name") == "__keras_tensor__":
+                    shape = obj.get("config", {}).get("shape")
+                    if shape is not None:
+                        found.append(len(shape))
+                else:
+                    for v in obj.values():
+                        collect(v)
+            elif isinstance(obj, (list, tuple)):
+                for v in obj:
+                    collect(v)
+        collect(node.get("args", []))
+        if found:
+            return found[0]
+    return None
+
+
+def _merge_vertex(layer: dict):
+    cls = layer["class_name"]
+    if cls == "Merge":  # Keras 1
+        mode = _cfg(layer).get("mode", "sum")
+        if mode not in _MERGE_MODES:
+            raise ValueError(f"Unsupported Keras-1 Merge mode '{mode}'")
+        cls = _MERGE_MODES[mode]
+    if cls not in _MERGE_CLASSES:
+        return None
+    if cls == "Concatenate":
+        # only feature-axis (last-axis) merges map to MergeVertex, like
+        # the reference's KerasMerge; "last axis" is rank-dependent —
+        # axis=1 IS the feature axis of [B,F] Dense outputs
+        axis = _cfg(layer).get("axis", -1)
+        rank = _inbound_rank(layer)
+        ok = (axis == -1 or (rank is not None and axis == rank - 1)
+              or (rank is None and axis == 3))  # legacy NHWC assumption
+        if not ok:
+            raise ValueError(
+                f"Concatenate axis {axis} unsupported for rank-{rank} "
+                "inputs (feature-axis merge only, like the reference "
+                "MergeVertex)")
+    return _MERGE_CLASSES[cls](_cfg(layer))
+
+
+def _import_functional(path: str, config: dict):
+    """Branched functional DAG -> ComputationGraph with weights."""
+    import h5py
+
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    cfg = config["config"]
+    layers = cfg["layers"]
+    translator = KerasLayerTranslator()
+
+    dim_ordering = "tf"
+    for ld in layers:
+        d = _cfg(ld).get("dim_ordering") or _cfg(ld).get("data_format")
+        if d:
+            dim_ordering = {"channels_first": "th",
+                            "channels_last": "tf"}.get(d, d)
+            break
+
+    def _refs(v):  # ['name', 0, 0] | [['a',0,0], ['b',0,0]] | ['a', 'b']
+        if not isinstance(v, (list, tuple)):
+            return [v]
+        if v and not isinstance(v[0], (list, tuple)):
+            # either a single ['name', n, t] triple or a list of names
+            if len(v) >= 2 and isinstance(v[1], int):
+                return [v[0]]
+            return list(v)
+        return [r[0] if isinstance(r, (list, tuple)) else r for r in v]
+
+    output_names = _refs(cfg.get("output_layers", []))
+    input_names = _refs(cfg.get("input_layers", []))
+
+    builder = (NeuralNetConfiguration.builder().seed(12345).graph_builder())
+    alias: dict = {}       # dropped layer name -> upstream effective name
+    keras_names: list = [] # vertex names that carry weights
+    input_types: dict = {}
+
+    def resolve(name):
+        while name in alias:
+            name = alias[name]
+        return name
+
+    for ld in layers:
+        cls = ld["class_name"]
+        name = _cfg(ld).get("name") or ld.get("name")
+        ins = [resolve(n) for n in _inbound_names(ld)]
+        if cls == "InputLayer":
+            it = translator.input_type(ld, dim_ordering)
+            builder.add_inputs(name)
+            if it is not None:
+                input_types[name] = it
+            continue
+        mv = _merge_vertex(ld)
+        if mv is not None:
+            builder.add_vertex(name, mv, *ins)
+            continue
+        t = translator.translate(ld, is_last=(name in output_names))
+        if t is None or t == "flatten":
+            # flatten is absorbed by the builder's automatic
+            # CnnToFeedForward preprocessor on the consumer (parity:
+            # KerasModel.java:487 preprocessor insertion)
+            alias[name] = ins[0]
+            continue
+        builder.add_layer(name, t, *ins)
+        keras_names.append(name)
+
+    if not input_names:
+        raise ValueError("Functional model config lists no input_layers")
+    missing = [n for n in input_names if n not in input_types]
+    if missing:
+        raise ValueError(f"Could not infer input shape for {missing} "
+                         "(no batch shape on the InputLayer)")
+    builder.set_input_types(*[input_types[n] for n in input_names])
+    builder.set_outputs(*[resolve(n) for n in output_names])
+    conf = builder.build()
+    net = ComputationGraph(conf).init()
+
+    with h5py.File(path, "r") as f:
+        for name in keras_names:
+            ws = _weight_arrays(f, name)
+            if not ws:
+                continue
+            v = conf.vertices[name]
+            p = dict(net.params.get(name, {}))
+            st = dict(net.state.get(name, {}))
+            new_p, new_st = _layer_param_update(
+                v.layer, p, st, ws, dim_ordering, v.preprocessor)
+            net.params[name] = new_p
+            if new_st is not None:
+                net.state[name] = new_st
+    return net
 
 
 def import_keras_sequential_model_and_weights(path: str) -> MultiLayerNetwork:
@@ -285,6 +508,55 @@ def _weight_arrays(f, keras_name: str):
     return out
 
 
+def _layer_param_update(layer, p, st, ws, dim_ordering, preprocessor):
+    """Apply one keras layer's weight arrays ``ws`` to its native param
+    dict ``p`` (+ state ``st`` for BN running stats). Shared by the
+    sequential (flat-indexed) and functional (vertex-named) importers.
+    Returns (new_params, new_state_or_None)."""
+    new_st = None
+    if isinstance(layer, ConvolutionLayer):
+        k, b = ws[0], (ws[1] if len(ws) > 1 else None)
+        if k.ndim == 4 and dim_ordering == "th":
+            # [out, in, kh, kw] true-conv -> HWIO cross-correlation
+            k = np.transpose(k, (2, 3, 1, 0))[::-1, ::-1]
+        p["W"] = jnp.asarray(np.ascontiguousarray(k), p["W"].dtype)
+        if b is not None:
+            p["b"] = jnp.asarray(b, p["b"].dtype)
+    elif isinstance(layer, (DenseLayer, OutputLayer)):
+        W, b = ws[0], (ws[1] if len(ws) > 1 else None)
+        if W.shape != tuple(p["W"].shape):
+            raise ValueError(
+                f"Dense weight shape {W.shape} != expected "
+                f"{tuple(p['W'].shape)}")
+        if (dim_ordering == "th" and preprocessor is not None
+                and hasattr(preprocessor, "channels")):
+            # keras th Flatten emitted (c,h,w) order; our flatten is
+            # NHWC -> permute rows (reference:
+            # TensorFlowCnnToFeedForwardPreProcessor inverse)
+            h_, w_, c_ = (preprocessor.height, preprocessor.width,
+                          preprocessor.channels)
+            perm = np.arange(c_ * h_ * w_).reshape(
+                c_, h_, w_).transpose(1, 2, 0).ravel()
+            W = W[perm]
+        p["W"] = jnp.asarray(W, p["W"].dtype)
+        if b is not None:
+            p["b"] = jnp.asarray(b, p["b"].dtype)
+    elif isinstance(layer, BatchNormalization):
+        # keras order: gamma, beta, moving_mean, moving_var
+        for name, w in zip(["gamma", "beta"], ws[:2]):
+            if name in p:
+                p[name] = jnp.asarray(w, p[name].dtype)
+        if len(ws) >= 4:
+            new_st = dict(st)
+            new_st["mean"] = jnp.asarray(ws[2])
+            new_st["var"] = jnp.asarray(ws[3])
+    elif isinstance(layer, LSTM):
+        p.update(_lstm_weights(ws, layer, p))
+    elif isinstance(layer, EmbeddingLayer):
+        p["W"] = jnp.asarray(ws[0], p["W"].dtype)
+    return p, new_st
+
+
 def _copy_weights(path, net, keras_names, dim_ordering):
     import h5py
 
@@ -294,51 +566,12 @@ def _copy_weights(path, net, keras_names, dim_ordering):
             if not ws:
                 continue
             key = str(i)
-            p = dict(net.params[key])
-            if isinstance(layer, ConvolutionLayer):
-                k, b = ws[0], (ws[1] if len(ws) > 1 else None)
-                if k.ndim == 4 and dim_ordering == "th":
-                    # [out, in, kh, kw] true-conv -> HWIO cross-correlation
-                    k = np.transpose(k, (2, 3, 1, 0))[::-1, ::-1]
-                p["W"] = jnp.asarray(np.ascontiguousarray(k),
-                                     p["W"].dtype)
-                if b is not None:
-                    p["b"] = jnp.asarray(b, p["b"].dtype)
-            elif isinstance(layer, (DenseLayer, OutputLayer)):
-                W, b = ws[0], (ws[1] if len(ws) > 1 else None)
-                if W.shape != tuple(p["W"].shape):
-                    raise ValueError(
-                        f"Dense weight shape {W.shape} != expected "
-                        f"{tuple(p['W'].shape)} for layer {i}")
-                pre = net.conf.preprocessors.get(i)
-                if (dim_ordering == "th" and pre is not None
-                        and hasattr(pre, "channels")):
-                    # keras th Flatten emitted (c,h,w) order; our flatten is
-                    # NHWC -> permute rows (reference:
-                    # TensorFlowCnnToFeedForwardPreProcessor inverse)
-                    h_, w_, c_ = pre.height, pre.width, pre.channels
-                    perm = np.arange(c_ * h_ * w_).reshape(
-                        c_, h_, w_).transpose(1, 2, 0).ravel()
-                    W = W[perm]
-                p["W"] = jnp.asarray(W, p["W"].dtype)
-                if b is not None:
-                    p["b"] = jnp.asarray(b, p["b"].dtype)
-            elif isinstance(layer, BatchNormalization):
-                # keras order: gamma, beta, moving_mean, moving_var
-                names = ["gamma", "beta"]
-                for name, w in zip(names, ws[:2]):
-                    if name in p:
-                        p[name] = jnp.asarray(w, p[name].dtype)
-                st = dict(net.state.get(key, {}))
-                if len(ws) >= 4:
-                    st["mean"] = jnp.asarray(ws[2])
-                    st["var"] = jnp.asarray(ws[3])
-                    net.state[key] = st
-            elif isinstance(layer, LSTM):
-                p.update(_lstm_weights(ws, layer, p))
-            elif isinstance(layer, EmbeddingLayer):
-                p["W"] = jnp.asarray(ws[0], p["W"].dtype)
+            p, new_st = _layer_param_update(
+                layer, dict(net.params[key]), dict(net.state.get(key, {})),
+                ws, dim_ordering, net.conf.preprocessors.get(i))
             net.params[key] = p
+            if new_st is not None:
+                net.state[key] = new_st
 
 
 def _lstm_weights(ws, layer, p):
