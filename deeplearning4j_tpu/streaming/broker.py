@@ -1,0 +1,236 @@
+"""TCP pub/sub topic broker for NDArray streams.
+
+Reference semantics: the Kafka broker in dl4j-streaming's routes
+(CamelKafkaRouteBuilder.java:16 wires record publishers to topic
+consumers). This is the minimal broker that gives the same contract on
+one machine or a LAN: named topics, many publishers, many subscribers
+(every subscriber sees every frame — Kafka consumer-group-per-subscriber
+semantics), bounded per-subscriber buffering with publisher backpressure,
+and an explicit end-of-stream marker.
+
+Wire protocol (all big-endian):
+    frame   = op(1) topic_len(2) topic payload_len(4) payload
+    ops     : P publish data | E end-of-topic | S subscribe (payload "")
+Subscribers receive the publisher's P/E frames verbatim for their topic.
+
+Run standalone: ``python -m deeplearning4j_tpu.streaming.broker --port N``
+or embedded: ``StreamingBroker(port=0).start()``.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import struct
+import threading
+from typing import Optional
+
+_HDR = struct.Struct(">cH")
+_LEN = struct.Struct(">I")
+
+OP_PUBLISH = b"P"
+OP_END = b"E"
+OP_SUBSCRIBE = b"S"
+
+MAX_FRAME_BYTES = 1 << 30  # defensive bound on payload_len
+
+
+def read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def read_frame(sock: socket.socket):
+    """(op, topic, payload) or None on clean EOF."""
+    hdr = read_exact(sock, _HDR.size)
+    if hdr is None:
+        return None
+    op, tlen = _HDR.unpack(hdr)
+    topic = read_exact(sock, tlen)
+    if topic is None:
+        return None
+    raw = read_exact(sock, _LEN.size)
+    if raw is None:
+        return None
+    (plen,) = _LEN.unpack(raw)
+    if plen > MAX_FRAME_BYTES:
+        raise ValueError(f"frame of {plen} bytes exceeds the "
+                         f"{MAX_FRAME_BYTES}-byte bound")
+    payload = read_exact(sock, plen) if plen else b""
+    if payload is None:
+        return None
+    return op, topic.decode("utf-8"), payload
+
+
+def write_frame(sock: socket.socket, op: bytes, topic: str,
+                payload: bytes = b"") -> None:
+    t = topic.encode("utf-8")
+    sock.sendall(_HDR.pack(op, len(t)) + t + _LEN.pack(len(payload))
+                 + payload)
+
+
+class _Subscriber:
+    def __init__(self, sock: socket.socket, topic: str, maxsize: int):
+        self.sock = sock
+        self.topic = topic
+        self.q: queue.Queue = queue.Queue(maxsize=maxsize)
+        self.alive = True
+
+
+class StreamingBroker:
+    """Threaded topic broker. ``port=0`` picks a free port (see
+    ``.port``). One writer thread per subscriber drains its bounded
+    queue; a publish blocks (backpressure) while ANY live subscriber's
+    queue is full — a slow consumer throttles the stream instead of
+    exhausting broker memory, the same role Kafka's bounded log +
+    consumer lag plays for the reference."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 subscriber_buffer: int = 16):
+        self.host = host
+        self.port = port
+        self.subscriber_buffer = subscriber_buffer
+        self._subs: dict = {}          # topic -> [_Subscriber]
+        self._lock = threading.Lock()
+        self._server: Optional[socket.socket] = None
+        self._threads: list = []
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "StreamingBroker":
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((self.host, self.port))
+        self.port = self._server.getsockname()[1]
+        self._server.listen(64)
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name="broker-accept")
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        with self._lock:
+            subs = [s for ss in self._subs.values() for s in ss]
+        for s in subs:
+            s.alive = False
+            try:
+                s.sock.close()
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------- serving
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn: socket.socket):
+        try:
+            while True:
+                frame = read_frame(conn)
+                if frame is None:
+                    return
+                op, topic, payload = frame
+                if op == OP_SUBSCRIBE:
+                    self._add_subscriber(conn, topic)
+                    return  # connection is now a subscriber: writer owns it
+                if op in (OP_PUBLISH, OP_END):
+                    self._fan_out(op, topic, payload)
+        except (OSError, ValueError):
+            pass
+        finally:
+            if not self._is_subscriber_sock(conn):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def _is_subscriber_sock(self, conn):
+        with self._lock:
+            return any(s.sock is conn for ss in self._subs.values()
+                       for s in ss)
+
+    def _add_subscriber(self, conn: socket.socket, topic: str):
+        sub = _Subscriber(conn, topic, self.subscriber_buffer)
+        with self._lock:
+            self._subs.setdefault(topic, []).append(sub)
+        t = threading.Thread(target=self._writer, args=(sub,), daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _writer(self, sub: _Subscriber):
+        try:
+            while sub.alive:
+                try:
+                    op, payload = sub.q.get(timeout=0.2)
+                except queue.Empty:
+                    if self._stop.is_set():
+                        return
+                    continue
+                write_frame(sub.sock, op, sub.topic, payload)
+                if op == OP_END:
+                    return
+        except OSError:
+            pass
+        finally:
+            sub.alive = False
+            with self._lock:
+                ss = self._subs.get(sub.topic, [])
+                if sub in ss:
+                    ss.remove(sub)
+            try:
+                sub.sock.close()
+            except OSError:
+                pass
+
+    def _fan_out(self, op: bytes, topic: str, payload: bytes):
+        with self._lock:
+            subs = list(self._subs.get(topic, []))
+        for s in subs:
+            while s.alive and not self._stop.is_set():
+                try:
+                    s.q.put((op, payload), timeout=0.2)  # backpressure
+                    break
+                except queue.Full:
+                    continue
+
+
+def main(argv=None):
+    import argparse
+    import time
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=9092)
+    ap.add_argument("--buffer", type=int, default=16,
+                    help="per-subscriber frame buffer (backpressure bound)")
+    args = ap.parse_args(argv)
+    broker = StreamingBroker(args.host, args.port, args.buffer).start()
+    print(f"streaming broker listening on {broker.host}:{broker.port}",
+          flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        broker.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,134 @@
+"""Publisher/consumer clients + the route builder.
+
+Reference: streaming/kafka/NDArrayPublisher.java (publish NDArrays to a
+topic), kafka/NDArrayConsumer.java (getArrays/consume), and
+routes/CamelKafkaRouteBuilder.java:16 (wire a record stream into
+training). The transport is the in-repo TCP broker
+(streaming/broker.py); the payloads are npz-encoded DataSets
+(streaming/serde.py).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Iterator, Optional
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.streaming import QueueDataSetIterator
+from deeplearning4j_tpu.streaming.broker import (
+    OP_END,
+    OP_PUBLISH,
+    OP_SUBSCRIBE,
+    read_frame,
+    write_frame,
+)
+from deeplearning4j_tpu.streaming.serde import (
+    dataset_from_bytes,
+    dataset_to_bytes,
+)
+
+
+class NDArrayPublisher:
+    """Publish DataSet minibatches to a broker topic
+    (NDArrayPublisher.java analog; also usable as a context manager)."""
+
+    def __init__(self, host: str, port: int, topic: str,
+                 connect_timeout: Optional[float] = 30.0):
+        self.topic = topic
+        self._sock = socket.create_connection((host, port),
+                                              timeout=connect_timeout)
+        # the timeout bounds CONNECT only: a publish blocked on broker
+        # backpressure for minutes is the documented contract, not an
+        # error — the socket must block indefinitely after connect
+        self._sock.settimeout(None)
+
+    def publish(self, ds: DataSet) -> None:
+        write_frame(self._sock, OP_PUBLISH, self.topic, dataset_to_bytes(ds))
+
+    def publish_arrays(self, features, labels) -> None:
+        self.publish(DataSet(features, labels))
+
+    def end(self) -> None:
+        """Signal end-of-stream to every subscriber of the topic."""
+        write_frame(self._sock, OP_END, self.topic)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class NDArrayConsumer:
+    """Subscribe to a topic and iterate arriving DataSets until the
+    publisher ends the stream (NDArrayConsumer.java analog)."""
+
+    def __init__(self, host: str, port: int, topic: str,
+                 connect_timeout: Optional[float] = 30.0):
+        self.topic = topic
+        self._sock = socket.create_connection((host, port),
+                                              timeout=connect_timeout)
+        # CONNECT timeout only: a producer idling minutes between
+        # publishes is normal for a live training feed; a recv timeout
+        # here would surface as a silent early end-of-stream to fit()
+        self._sock.settimeout(None)
+        write_frame(self._sock, OP_SUBSCRIBE, topic)
+
+    def __iter__(self) -> Iterator[DataSet]:
+        while True:
+            frame = read_frame(self._sock)
+            if frame is None:
+                return  # broker gone: treat as stream end
+            op, _, payload = frame
+            if op == OP_END:
+                return
+            yield dataset_from_bytes(payload)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class NDArrayRoute:
+    """CamelKafkaRouteBuilder analog: one call wires a broker topic into
+    a training-ready iterator. A background thread drains the consumer
+    into a bounded QueueDataSetIterator (push-queue backpressure), so
+    ``route.iterator()`` plugs straight into ``net.fit(...)`` while a
+    producer in another process keeps publishing."""
+
+    def __init__(self, host: str, port: int, topic: str,
+                 buffer_batches: int = 16):
+        self.consumer = NDArrayConsumer(host, port, topic)
+        self._it = QueueDataSetIterator(maxsize=buffer_batches)
+        self._thread = threading.Thread(target=self._pump, daemon=True,
+                                        name=f"route-{topic}")
+        self._thread.start()
+
+    def _pump(self):
+        try:
+            for ds in self.consumer:
+                self._it.put(ds)
+        finally:
+            self._it.end()
+            self.consumer.close()
+
+    def iterator(self) -> QueueDataSetIterator:
+        return self._it
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        self._thread.join(timeout)

@@ -1,0 +1,33 @@
+"""DataSet <-> bytes for the streaming wire (reference:
+dl4j-streaming serde/RecordSerializer.java + kafka NDArray message
+payloads). npz container: self-describing shapes/dtypes, no pickle —
+a frame from an untrusted producer can only decode into arrays."""
+
+from __future__ import annotations
+
+import io
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+_FIELDS = ("features", "labels", "features_mask", "labels_mask")
+
+
+def dataset_to_bytes(ds: DataSet) -> bytes:
+    arrays = {}
+    for name in _FIELDS:
+        v = getattr(ds, name, None)
+        if v is not None:
+            arrays[name] = np.asarray(v)
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def dataset_from_bytes(payload: bytes) -> DataSet:
+    with np.load(io.BytesIO(payload), allow_pickle=False) as z:
+        kw = {name: z[name] for name in _FIELDS if name in z.files}
+    return DataSet(kw.get("features"), kw.get("labels"),
+                   features_mask=kw.get("features_mask"),
+                   labels_mask=kw.get("labels_mask"))

@@ -1,0 +1,162 @@
+"""Cross-process broker streaming (deeplearning4j_tpu/streaming/ — the
+dl4j-streaming Kafka/Camel analog: CamelKafkaRouteBuilder.java:16,
+kafka/NDArrayPublisher.java, kafka/NDArrayConsumer.java).
+
+The headline test is the reference's end-to-end contract: a producer in a
+SEPARATE PROCESS publishes minibatches to a broker topic while this
+process trains ``net.fit`` on the subscribed route."""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.streaming import (
+    NDArrayConsumer,
+    NDArrayPublisher,
+    NDArrayRoute,
+    StreamingBroker,
+    dataset_from_bytes,
+    dataset_to_bytes,
+)
+
+
+def _net():
+    from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers.core import (DenseLayer,
+                                                        OutputLayer)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updater import Adam
+
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .updater(Adam(learning_rate=1e-2))
+            .list(DenseLayer(n_out=8, activation="relu"),
+                  OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestSerde:
+    def test_roundtrip_with_masks(self):
+        rs = np.random.RandomState(0)
+        ds = DataSet(rs.randn(3, 5, 7).astype(np.float32),
+                     rs.randn(3, 5, 2).astype(np.float32),
+                     features_mask=(rs.rand(3, 7) > 0.3).astype(np.float32),
+                     labels_mask=(rs.rand(3, 7) > 0.3).astype(np.float32))
+        back = dataset_from_bytes(dataset_to_bytes(ds))
+        np.testing.assert_array_equal(back.features, ds.features)
+        np.testing.assert_array_equal(back.labels, ds.labels)
+        np.testing.assert_array_equal(back.features_mask, ds.features_mask)
+        np.testing.assert_array_equal(back.labels_mask, ds.labels_mask)
+
+    def test_roundtrip_without_masks(self):
+        ds = DataSet(np.ones((2, 3), np.float32), np.eye(2, dtype=np.float32))
+        back = dataset_from_bytes(dataset_to_bytes(ds))
+        np.testing.assert_array_equal(back.features, ds.features)
+        assert back.features_mask is None and back.labels_mask is None
+
+
+class TestBrokerInProcess:
+    def test_pub_sub_roundtrip(self):
+        broker = StreamingBroker(port=0).start()
+        try:
+            with NDArrayConsumer("127.0.0.1", broker.port, "t1") as cons, \
+                    NDArrayPublisher("127.0.0.1", broker.port, "t1") as pub:
+                sent = [DataSet(np.full((2, 3), i, np.float32),
+                                np.eye(2, dtype=np.float32))
+                        for i in range(5)]
+                for ds in sent:
+                    pub.publish(ds)
+                pub.end()
+                got = list(cons)
+            assert len(got) == 5
+            for i, ds in enumerate(got):
+                assert float(ds.features[0, 0]) == i
+        finally:
+            broker.stop()
+
+    def test_fan_out_two_subscribers(self):
+        """Every subscriber sees every frame (Kafka
+        consumer-group-per-subscriber semantics)."""
+        import threading
+
+        broker = StreamingBroker(port=0).start()
+        try:
+            c1 = NDArrayConsumer("127.0.0.1", broker.port, "t2")
+            c2 = NDArrayConsumer("127.0.0.1", broker.port, "t2")
+            out1, out2 = [], []
+            t1 = threading.Thread(target=lambda: out1.extend(c1))
+            t2 = threading.Thread(target=lambda: out2.extend(c2))
+            t1.start()
+            t2.start()
+            with NDArrayPublisher("127.0.0.1", broker.port, "t2") as pub:
+                for i in range(4):
+                    pub.publish_arrays(np.full((1, 2), i, np.float32),
+                                       np.ones((1, 1), np.float32))
+                pub.end()
+            t1.join(10)
+            t2.join(10)
+            assert len(out1) == 4 and len(out2) == 4
+        finally:
+            broker.stop()
+
+    def test_topics_are_isolated(self):
+        broker = StreamingBroker(port=0).start()
+        try:
+            ca = NDArrayConsumer("127.0.0.1", broker.port, "a")
+            with NDArrayPublisher("127.0.0.1", broker.port, "a") as pa, \
+                    NDArrayPublisher("127.0.0.1", broker.port, "b") as pb:
+                pb.publish_arrays(np.zeros((1, 1), np.float32),
+                                  np.zeros((1, 1), np.float32))
+                pb.end()
+                pa.publish_arrays(np.ones((1, 1), np.float32),
+                                  np.ones((1, 1), np.float32))
+                pa.end()
+            got = list(ca)
+            assert len(got) == 1 and float(got[0].features[0, 0]) == 1.0
+        finally:
+            broker.stop()
+
+
+_PRODUCER_SCRIPT = r"""
+import sys
+import numpy as np
+from deeplearning4j_tpu.streaming import NDArrayPublisher
+
+port, n_batches = int(sys.argv[1]), int(sys.argv[2])
+rs = np.random.RandomState(3)
+with NDArrayPublisher("127.0.0.1", port, "train") as pub:
+    for i in range(n_batches):
+        x = rs.randn(16, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 16)]
+        pub.publish_arrays(x, y)
+    pub.end()
+print("published", n_batches, flush=True)
+"""
+
+
+class TestCrossProcess:
+    def test_fit_from_separate_producer_process(self, tmp_path):
+        """The reference's end-to-end route: another PROCESS publishes
+        NDArray minibatches to the broker while this process trains on
+        the subscribed topic (CamelKafkaRouteBuilder semantics)."""
+        n_batches = 12
+        broker = StreamingBroker(port=0).start()
+        try:
+            route = NDArrayRoute("127.0.0.1", broker.port, "train")
+            producer = subprocess.Popen(
+                [sys.executable, "-c", _PRODUCER_SCRIPT,
+                 str(broker.port), str(n_batches)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+            net = _net()
+            net.fit(route.iterator())  # trains WHILE the producer runs
+            out, err = producer.communicate(timeout=60)
+            assert producer.returncode == 0, err
+            assert f"published {n_batches}" in out
+            assert net.iteration == n_batches
+            assert np.isfinite(net.score_value)
+        finally:
+            broker.stop()
