@@ -27,6 +27,13 @@ class TrainingListener:
     def on_epoch_end(self, model):
         pass
 
+    def on_phase_timings(self, model, timings: dict):
+        """Per-round training-phase wall times (reference:
+        spark/api/stats/SparkTrainingStats.java — data-fetch / fit /
+        aggregation timings per worker round). ``timings`` carries ms
+        floats, e.g. {"host_prep_ms": ..., "device_round_ms": ...}."""
+        pass
+
 
 class ScoreIterationListener(TrainingListener):
     """Log score every N iterations (reference: ScoreIterationListener)."""

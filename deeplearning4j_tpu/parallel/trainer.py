@@ -24,6 +24,7 @@ equals single-device training on the concatenated N*B batch, to float tolerance.
 
 from __future__ import annotations
 
+import time
 import warnings
 from typing import Optional
 
@@ -38,6 +39,7 @@ from deeplearning4j_tpu.nn.gradient_normalization import (
     apply_gradient_normalization,
     layer_map_for,
 )
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
 from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, data_mesh
 
 AVERAGING = "averaging"
@@ -69,6 +71,8 @@ class ParallelWrapper:
         # mid-stream batches whose size didn't match the stream's (dropped
         # with a warning — see fit); genuine trailing partials not counted
         self.dropped_batches = 0
+        # last round's phase wall times (SparkTrainingStats analog)
+        self.last_phase_timings: dict = {}
         self._round_cache: dict = {}
 
     # ------------------------------------------------------------------ build
@@ -212,6 +216,7 @@ class ParallelWrapper:
         """One averaging round from W*F host minibatches."""
         net = self.net
         W, F = self.workers, self.averaging_frequency
+        t_prep0 = time.perf_counter()
         feats = np.stack([np.asarray(b.features) for b in batches])  # [W*F, B, ...]
         labs = np.stack([np.asarray(b.labels) for b in batches])
         has_im = any(b.features_mask is not None for b in batches)
@@ -239,15 +244,43 @@ class ParallelWrapper:
         feats, labs, ims, lms = map(regroup, (feats, labs, ims, lms))
         key = (feats.shape, labs.shape, has_im, has_lm)
         rnd = self._get_round(key)
+        t_dev0 = time.perf_counter()
         rng = jax.random.fold_in(jax.random.PRNGKey(net.conf.seed), net.iteration)
         params, opt, state, loss = rnd(
             net.params, net.updater_state, net.state, rng,
             jnp.asarray(net.iteration, jnp.float32), feats, labs, ims, lms)
         net.params, net.updater_state, net.state = params, opt, state
         net.iteration += F
+        listeners = getattr(net, "listeners", [])
+        # timings need a device sync; report_score already pays one.
+        # report_score=False exists precisely to let the next round's
+        # host prep overlap the device compute — only a listener that
+        # actually consumes phase timings may re-introduce the block.
+        want_timings = self.report_score or any(
+            type(ls).on_phase_timings is not TrainingListener.on_phase_timings
+            for ls in listeners)
         if self.report_score:
-            net.score_value = float(loss)
-        for listener in getattr(net, "listeners", []):
+            net.score_value = float(loss)  # forces device round completion
+        elif want_timings:
+            jax.block_until_ready(loss)
+        if want_timings:
+            t_end = time.perf_counter()
+            # per-round phase stats (reference: SparkTrainingStats —
+            # data-fetch / fit / aggregation per worker round). Averaging
+            # is INSIDE the jitted device round here (one pmean), so it
+            # cannot be timed separately from fit — reported as part of
+            # device_round_ms, with the key present so consumers see the
+            # design, not a hole.
+            self.last_phase_timings = {
+                "host_prep_ms": (t_dev0 - t_prep0) * 1e3,
+                "device_round_ms": (t_end - t_dev0) * 1e3,
+                "averaging": "in-device-round",
+                "round_iterations": F,
+                "workers": W,
+            }
+            for listener in listeners:
+                listener.on_phase_timings(net, dict(self.last_phase_timings))
+        for listener in listeners:
             listener.iteration_done(net, net.iteration)
 
     # ------------------------------------------------------------- utilities

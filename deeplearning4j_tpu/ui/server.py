@@ -98,19 +98,27 @@ _SYSTEM_PAGE = """<!doctype html><html><head><title>system</title>
 <h2>System</h2>
 <h3>Host memory (RSS, MB)</h3><canvas id="mem" width="800" height="220"></canvas>
 <h3>Iterations / second</h3><canvas id="ips" width="800" height="220"></canvas>
+<h3>Training phases (ms per round)
+<span style="color:#06c">host batch-prep</span> /
+<span style="color:#c60">device round (incl. averaging)</span></h3>
+<canvas id="phases" width="800" height="220"></canvas>
 <script>
-function line(id,xs,ys){
+function line(id,xs,ys,color,clear=true,yminO=null,ymaxO=null){
  const c=document.getElementById(id).getContext('2d');
- c.clearRect(0,0,800,220);
- if(xs.length<2)return;
- const ymax=Math.max(...ys),ymin=Math.min(...ys);
+ if(clear)c.clearRect(0,0,800,220);
+ const pairs=xs.map((x,i)=>[x,ys[i]]).filter(p=>p[1]!=null);
+ if(pairs.length<2)return;
+ const vy=pairs.map(p=>p[1]);
+ const ymax=ymaxO!=null?ymaxO:Math.max(...vy);
+ const ymin=yminO!=null?yminO:Math.min(...vy);
+ const x0=pairs[0][0],x1=pairs[pairs.length-1][0];
  c.beginPath();
- xs.forEach((x,i)=>{
-  const px=40+(x-xs[0])/(xs[xs.length-1]-xs[0]||1)*740;
-  const py=200-(ys[i]-ymin)/((ymax-ymin)||1)*180;
+ pairs.forEach((p,i)=>{
+  const px=40+(p[0]-x0)/((x1-x0)||1)*740;
+  const py=200-(p[1]-ymin)/((ymax-ymin)||1)*180;
   i?c.lineTo(px,py):c.moveTo(px,py);});
- c.strokeStyle='#06c';c.stroke();
- c.fillText(ymax.toFixed(2),2,20);c.fillText(ymin.toFixed(2),2,205);
+ c.strokeStyle=color||'#06c';c.stroke();
+ if(clear){c.fillText(ymax.toFixed(2),2,20);c.fillText(ymin.toFixed(2),2,205);}
 }
 async function refresh(){
  const sids=await (await fetch('/train/sessions')).json();
@@ -118,6 +126,14 @@ async function refresh(){
  const s=await (await fetch('/train/system?sid='+sids[sids.length-1])).json();
  line('mem',s.iterations,s.memory_mb);
  line('ips',s.iterations.slice(1),s.iterations_per_second.slice(1));
+ // shared y-scale: the chart exists to COMPARE host prep vs device
+ // round, so both series must map ms to pixels identically
+ const pv=[...s.host_prep_ms,...s.device_round_ms].filter(v=>v!=null);
+ if(pv.length){
+  const pmin=Math.min(...pv),pmax=Math.max(...pv);
+  line('phases',s.iterations,s.host_prep_ms,'#06c',true,pmin,pmax);
+  line('phases',s.iterations,s.device_round_ms,'#c60',false,pmin,pmax);
+ }
 }
 refresh();setInterval(refresh,2000);
 </script></body></html>"""
@@ -338,16 +354,22 @@ class UIServer:
         return {}
 
     def system_info(self, session_id) -> dict:
-        """Memory / throughput series (reference: TrainModule system tab)."""
-        iters, mem, ips = [], [], []
+        """Memory / throughput / phase-timing series (reference:
+        TrainModule system tab + SparkTrainingStats' per-round
+        data-fetch/fit timings)."""
+        iters, mem, ips, prep, dev = [], [], [], [], []
         for s in self.storages:
             for r in s.get_all_updates_after(session_id, TYPE_ID):
                 iters.append(r["data"].get("iteration"))
                 mem.append(
                     (r["data"].get("memory_rss_bytes") or 0) / 1e6)
                 ips.append(r["data"].get("iterations_per_second"))
+                pt = r["data"].get("phase_timings") or {}
+                prep.append(pt.get("host_prep_ms"))
+                dev.append(pt.get("device_round_ms"))
         return {"iterations": iters, "memory_mb": mem,
-                "iterations_per_second": ips}
+                "iterations_per_second": ips,
+                "host_prep_ms": prep, "device_round_ms": dev}
 
     # bounds for HTTP-uploaded embeddings: the UI port is reachable by any
     # local process, so memory growth must be capped (oldest session is

@@ -79,11 +79,18 @@ class StatsListener(TrainingListener):
         self._last_params_norms = None
         self._last_time = None
         self._last_iter = None
+        self._pending_phase_timings = None
 
     # ------------------------------------------------------------------ hooks
     def on_epoch_start(self, model):
         if not self._static_sent:
             self._send_static(model)
+
+    def on_phase_timings(self, model, timings: dict):
+        """Buffer the round's phase wall times; they ride on the next
+        update record (reference: SparkTrainingStats routed through the
+        stats-storage pipeline)."""
+        self._pending_phase_timings = timings
 
     def _send_static(self, model):
         """Session/model/hardware info (reference: initializeReporting +
@@ -126,6 +133,9 @@ class StatsListener(TrainingListener):
             data["iterations_per_second"] = \
                 (iteration - self._last_iter) / dt if dt > 0 else None
             data["duration_ms"] = dt * 1000.0
+        if self._pending_phase_timings is not None:
+            data["phase_timings"] = self._pending_phase_timings
+            self._pending_phase_timings = None
         if self.collect_histograms:
             data["param_histograms"] = self._histograms(model.params)
         self.router.put_update(make_record(

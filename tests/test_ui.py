@@ -215,6 +215,51 @@ class TestUIServer:
         finally:
             server.stop()
 
+    def test_phase_timings_reach_system_page(self):
+        """Per-round phase stats (SparkTrainingStats analog): the
+        ParallelWrapper round's host-prep/device-round wall times flow
+        listener -> storage -> /train/system -> /system page."""
+        from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+        from deeplearning4j_tpu.parallel import ParallelWrapper, data_mesh
+
+        storage = InMemoryStatsStorage()
+        conf = (NeuralNetConfiguration.builder()
+                .seed(2).updater(Adam(learning_rate=0.01))
+                .list(DenseLayer(n_out=4, activation="relu"),
+                      OutputLayer(n_out=2, activation="softmax",
+                                  loss="mcxent"))
+                .set_input_type(InputType.feed_forward(3))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.set_listeners(StatsListener(storage, session_id="phases",
+                                        reporting_frequency=1))
+        rs = np.random.RandomState(2)
+        W, B = 4, 4
+        batches = [DataSet(rs.randn(B, 3).astype(np.float32),
+                           np.eye(2, dtype=np.float32)[
+                               rs.randint(0, 2, B)])
+                   for _ in range(W * 3)]
+        pw = ParallelWrapper(net, mesh=data_mesh(W), averaging_frequency=1)
+        pw.fit(ListDataSetIterator(batches, batch_size=B))
+        assert pw.last_phase_timings["device_round_ms"] > 0
+        assert pw.last_phase_timings["averaging"] == "in-device-round"
+
+        server = UIServer(port=0)
+        server.attach(storage)
+        port = server.start()
+        try:
+            base = f"http://127.0.0.1:{port}"
+            s = json.loads(urllib.request.urlopen(
+                base + "/train/system?sid=phases").read())
+            assert any(v is not None and v > 0
+                       for v in s["host_prep_ms"])
+            assert any(v is not None and v > 0
+                       for v in s["device_round_ms"])
+            page = urllib.request.urlopen(base + "/system").read().decode()
+            assert "Training phases" in page
+        finally:
+            server.stop()
+
     def test_tsne_eviction_is_least_recently_updated(self):
         """Re-uploading a session refreshes its eviction position: the
         actively updated session must survive while stale ones go."""
