@@ -17,3 +17,4 @@ from deeplearning4j_tpu.datasets.streaming import (
     QueueDataSetIterator,
     StreamingDataSetIterator,
 )
+from deeplearning4j_tpu.datasets.curves import CurvesDataSetIterator

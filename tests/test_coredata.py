@@ -340,3 +340,56 @@ class TestDeviceBruteForceKnn:
             assert one["results"][0]["index"] == 7
         finally:
             server.stop()
+
+
+class TestCurvesDataset:
+    """Curves iterator (datasets/curves.py — CurvesDataFetcher.java
+    analog, generated offline instead of the S3 curves.ser)."""
+
+    def test_shapes_labels_and_determinism(self):
+        from deeplearning4j_tpu.datasets import CurvesDataSetIterator
+
+        it = CurvesDataSetIterator(batch_size=32, num_examples=96)
+        batches = list(it)
+        assert len(batches) == 3
+        ds = batches[0]
+        assert ds.features.shape == (32, 784)
+        assert ds.features.dtype == np.float32
+        # reconstruction convention: labels ARE the features
+        np.testing.assert_array_equal(ds.features, ds.labels)
+        assert 0.0 <= float(ds.features.min()) and \
+            float(ds.features.max()) <= 1.0
+        # images are sparse strokes, not noise: a curve lights up only a
+        # small fraction of the 784 pixels
+        frac_lit = float((ds.features > 0.05).mean())
+        assert 0.01 < frac_lit < 0.4
+        again = list(CurvesDataSetIterator(batch_size=32, num_examples=96))
+        np.testing.assert_array_equal(ds.features, again[0].features)
+
+    def test_autoencoder_pretraining_reduces_error(self):
+        """The fetcher's purpose in the reference: unsupervised deep-AE
+        pretraining. Reconstruction MSE must drop when training on it."""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.datasets import CurvesDataSetIterator
+        from deeplearning4j_tpu.nn.conf.builders import \
+            NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers.core import (DenseLayer,
+                                                            OutputLayer)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.updater import Adam
+
+        conf = (NeuralNetConfiguration.builder().seed(5)
+                .updater(Adam(learning_rate=1e-3))
+                .list(DenseLayer(n_out=64, activation="relu"),
+                      OutputLayer(n_out=784, activation="sigmoid",
+                                  loss="mse"))
+                .set_input_type(InputType.feed_forward(784)).build())
+        net = MultiLayerNetwork(conf).init()
+        it = CurvesDataSetIterator(batch_size=64, num_examples=256)
+        s0 = net.score(next(iter(it)))
+        net.fit(it, epochs=8)
+        s1 = net.score(next(iter(CurvesDataSetIterator(
+            batch_size=64, num_examples=256))))
+        assert np.isfinite(s1) and s1 < s0
