@@ -56,8 +56,8 @@ class SelfAttentionLayer(BaseLayer):
     # Accelerated-kernel switch (the AlgoMode / cuDNN-helper analog,
     # reference: ConvolutionLayer.java:68-79 reflective helper load):
     # "auto" uses the Pallas flash kernel whenever it supports the case
-    # (no key mask, T divisible by its block), "pallas" forces it,
-    # "stock" forces the XLA softmax(QK^T)V path.
+    # (incl. [B,T] key masks since round 5; T divisible by its block),
+    # "pallas" forces it, "stock" forces the XLA softmax(QK^T)V path.
     helper: str = "auto"
 
     INPUT_KIND = "rnn"
@@ -106,11 +106,8 @@ class SelfAttentionLayer(BaseLayer):
             self.helper == "auto"
             and pa.supports(q.shape, mask=mask, dtype=q.dtype))
         if use_pallas:
-            if mask is not None:
-                raise ValueError(
-                    "helper='pallas' does not support key masks; use "
-                    "'auto' or 'stock'")
-            return pa.flash_attention(q, k, v, causal=self.causal)
+            return pa.flash_attention(q, k, v, causal=self.causal,
+                                      mask=mask)
         return scaled_dot_attention(q, k, v, causal=self.causal, mask=mask)
 
     def forward(self, params, state, x, *, mask=None, train=False, rng=None):

@@ -12,9 +12,10 @@ This kernel computes attention with the online-softmax (flash) recurrence:
 K/V stream through VMEM in blocks, scores never leave the chip, O(T) memory
 instead of O(T^2).
 
-Scope: forward + backward, optionally causal, no key-padding mask (callers
-fall back to the stock path when a mask is present — see
-SelfAttentionLayer.forward's helper switch, the AlgoMode analog). The
+Scope: forward + backward, optionally causal, optional [B, T] key-padding
+mask (per-batch key-validity row broadcast over heads — the same
+semantics as the stock path; round 5 closed the last helper-vs-stock
+routing gap). The
 backward is the standard flash recompute-by-block scheme (dq kernel over
 q-blocks streaming K/V; dk/dv kernel over k-blocks streaming Q/dO), so
 long-T *training* keeps O(T) memory — scores are rebuilt from the saved
@@ -45,12 +46,18 @@ def _causal_mask(s, iq, ik, block_q, block_k):
     return jnp.where(rows >= cols, s, NEG_INF)
 
 
-def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float,
-                     causal: bool, block_q: int, block_k: int, seq_len: int):
+def _attn_fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale: float,
+                     causal: bool, has_mask: bool, block_q: int,
+                     block_k: int, seq_len: int):
     """One (batch*head, q-block) program: stream K/V blocks with the online
     softmax recurrence. q_ref: [block_q, d]; k_ref/v_ref: [T, d] (VMEM);
     o_ref: [block_q, d]; lse_ref: [block_q, 1] row logsumexp (saved for the
-    backward recompute)."""
+    backward recompute). With ``has_mask``, mask_ref is a [1, T] f32 key
+    validity row (shared by all heads of the batch)."""
+    if has_mask:
+        mask_ref, o_ref, lse_ref = rest
+    else:
+        o_ref, lse_ref = rest
     iq = pl.program_id(1)
     q = q_ref[:].astype(jnp.float32) * sm_scale
     d = q.shape[-1]
@@ -71,6 +78,14 @@ def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float,
                                 preferred_element_type=jnp.float32)
         if causal:
             s = _causal_mask(s, iq, i, block_q, block_k)
+        if has_mask:
+            # Mosaic requires lane-dim dynamic slices provably 128-aligned;
+            # flash_attention guarantees block_k % 128 == 0 (or one block)
+            # whenever a mask is present. != 0 matches the stock path's
+            # mask.astype(bool) semantics (any nonzero = valid).
+            km = (mask_ref[:] if block_k == seq_len
+                  else mask_ref[:, pl.ds(i * block_k, block_k)])
+            s = jnp.where(km != 0, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new[:, None])
@@ -90,27 +105,37 @@ def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float,
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
                                              "interpret"))
-def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int,
-                   interpret: bool):
+def _flash_forward(q, k, v, key_mask, *, causal: bool, block_q: int,
+                   block_k: int, interpret: bool):
     B, H, T, d = q.shape
     sm_scale = 1.0 / (d ** 0.5)
     qf = q.reshape(B * H, T, d)
     kf = k.reshape(B * H, T, d)
     vf = v.reshape(B * H, T, d)
+    has_mask = key_mask is not None
     kernel = functools.partial(
         _attn_fwd_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, seq_len=T)
+        has_mask=has_mask, block_q=block_q, block_k=block_k, seq_len=T)
+    in_specs = [
+        pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((None, T, d), lambda b, i: (b, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((None, T, d), lambda b, i: (b, 0, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    args = [qf, kf, vf]
+    if has_mask:
+        # [B, 1, T]: one validity row per batch, shared across its heads
+        # (program b belongs to batch b // H)
+        in_specs.append(pl.BlockSpec(
+            (None, 1, T), lambda b, i: (b // H, 0, 0),
+            memory_space=pltpu.VMEM))
+        args.append(key_mask.astype(jnp.float32).reshape(B, 1, T))
     out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, T // block_q),
-        in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((None, T, d), lambda b, i: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((None, T, d), lambda b, i: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0),
                          memory_space=pltpu.VMEM),
@@ -122,15 +147,19 @@ def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int,
             jax.ShapeDtypeStruct((B * H, T, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf)
+    )(*args)
     return out.reshape(B, H, T, d), lse
 
 
-def _attn_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                    *, sm_scale: float, causal: bool, block_q: int,
-                    block_k: int, seq_len: int):
+def _attn_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                    sm_scale: float, causal: bool, has_mask: bool,
+                    block_q: int, block_k: int, seq_len: int):
     """dQ for one (batch*head, q-block): stream K/V, recompute P from the
     saved logsumexp, accumulate dS K. All VMEM-resident, f32 accumulation."""
+    if has_mask:
+        mask_ref, dq_ref = rest
+    else:
+        (dq_ref,) = rest
     iq = pl.program_id(1)
     q = q_ref[:].astype(jnp.float32) * sm_scale
     do = do_ref[:].astype(jnp.float32)
@@ -151,6 +180,10 @@ def _attn_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                                 preferred_element_type=jnp.float32)
         if causal:
             s = _causal_mask(s, iq, i, block_q, block_k)
+        if has_mask:
+            km = (mask_ref[:] if block_k == seq_len
+                  else mask_ref[:, pl.ds(i * block_k, block_k)])
+            s = jnp.where(km != 0, s, NEG_INF)
         p = jnp.exp(s - lse)                      # normalized probabilities
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -164,10 +197,14 @@ def _attn_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _attn_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                     dk_ref, dv_ref, *, sm_scale: float, causal: bool,
+                     *rest, sm_scale: float, causal: bool, has_mask: bool,
                      block_q: int, block_k: int, seq_len: int):
     """dK/dV for one (batch*head, k-block): stream Q/dO blocks, recompute
     P^T, accumulate dV = P^T dO and dK = dS^T Q * scale."""
+    if has_mask:
+        mask_ref, dk_ref, dv_ref = rest  # mask_ref: [1, block_k]
+    else:
+        dk_ref, dv_ref = rest
     ik = pl.program_id(1)
     k_blk = k_ref[:].astype(jnp.float32)          # [block_k, d]
     v_blk = v_ref[:].astype(jnp.float32)
@@ -191,6 +228,8 @@ def _attn_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                 preferred_element_type=jnp.float32)
         if causal:
             s = _causal_mask(s, i, ik, block_q, block_k)
+        if has_mask:
+            s = jnp.where(mask_ref[:] != 0, s, NEG_INF)
         p = jnp.exp(s - lse)                      # [block_q, block_k]
         dv_new = dv + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -212,8 +251,8 @@ def _attn_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
                                              "interpret"))
-def _flash_backward(q, k, v, o, lse, do, *, causal: bool, block_q: int,
-                    block_k: int, interpret: bool):
+def _flash_backward(q, k, v, o, lse, do, key_mask, *, causal: bool,
+                    block_q: int, block_k: int, interpret: bool):
     B, H, T, d = q.shape
     sm_scale = 1.0 / (d ** 0.5)
     flat = lambda a: a.reshape(B * H, T, d)
@@ -221,6 +260,9 @@ def _flash_backward(q, k, v, o, lse, do, *, causal: bool, block_q: int,
     # D_i = dO_i . O_i — one fused elementwise-reduce in XLA, O(T d) reads
     delta = jnp.sum(dof.astype(jnp.float32)
                     * flat(o).astype(jnp.float32), axis=-1, keepdims=True)
+    has_mask = key_mask is not None
+    if has_mask:
+        mf = key_mask.astype(jnp.float32).reshape(B, 1, T)
 
     blk_q = pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0),
                          memory_space=pltpu.VMEM)
@@ -232,28 +274,45 @@ def _flash_backward(q, k, v, o, lse, do, *, causal: bool, block_q: int,
                         memory_space=pltpu.VMEM)
     full1 = pl.BlockSpec((None, T, 1), lambda b, i: (b, 0, 0),
                          memory_space=pltpu.VMEM)
+    # program b belongs to batch b // H; dq streams ALL key columns (full
+    # mask row), dkv sees only its own k-block's columns
+    mask_full = pl.BlockSpec((None, 1, T), lambda b, i: (b // H, 0, 0),
+                             memory_space=pltpu.VMEM)
+    mask_blk = pl.BlockSpec((None, 1, block_k), lambda b, i: (b // H, 0, i),
+                            memory_space=pltpu.VMEM)
 
+    dq_in = [blk_q, full, full, blk_q, blk_q1, blk_q1]
+    dq_args = [qf, kf, vf, dof, lse, delta]
+    if has_mask:
+        dq_in.append(mask_full)
+        dq_args.append(mf)
     dq = pl.pallas_call(
         functools.partial(_attn_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k, seq_len=T),
+                          has_mask=has_mask, block_q=block_q,
+                          block_k=block_k, seq_len=T),
         grid=(B * H, T // block_q),
-        in_specs=[blk_q, full, full, blk_q, blk_q1, blk_q1],
+        in_specs=dq_in,
         out_specs=blk_q,
         out_shape=jax.ShapeDtypeStruct((B * H, T, d), q.dtype),
         interpret=interpret,
-    )(qf, kf, vf, dof, lse, delta)
+    )(*dq_args)
 
+    dkv_in = [full, blk_k, blk_k, full, full1, full1]
+    dkv_args = [qf, kf, vf, dof, lse, delta]
+    if has_mask:
+        dkv_in.append(mask_blk)
+        dkv_args.append(mf)
     dk, dv = pl.pallas_call(
         functools.partial(_attn_dkv_kernel, sm_scale=sm_scale,
-                          causal=causal, block_q=block_q, block_k=block_k,
-                          seq_len=T),
+                          causal=causal, has_mask=has_mask, block_q=block_q,
+                          block_k=block_k, seq_len=T),
         grid=(B * H, T // block_k),
-        in_specs=[full, blk_k, blk_k, full, full1, full1],
+        in_specs=dkv_in,
         out_specs=[blk_k, blk_k],
         out_shape=[jax.ShapeDtypeStruct((B * H, T, d), k.dtype),
                    jax.ShapeDtypeStruct((B * H, T, d), v.dtype)],
         interpret=interpret,
-    )(qf, kf, vf, dof, lse, delta)
+    )(*dkv_args)
 
     unflat = lambda a: a.reshape(B, H, T, d)
     return unflat(dq), unflat(dk), unflat(dv)
@@ -275,7 +334,9 @@ def supports(q_shape, *, mask, dtype=jnp.float32,
     """Whether the ``auto`` helper should route here (callers fall back to
     the stock XLA path otherwise). Declines when:
 
-    - a key mask is present (kernel has no mask support);
+    - a key mask is present whose shape is not the [B, T] per-batch key
+      validity row the kernels understand (round 5: masked workloads no
+      longer force the stock path);
     - dtype is wider than float32 — the kernel casts to and accumulates in
       f32, so a float64 network would silently lose precision (breaks
       gradchecks); bf16/f16 inputs are fine (they gain precision);
@@ -285,7 +346,10 @@ def supports(q_shape, *, mask, dtype=jnp.float32,
     - T*d exceeds the VMEM ceiling (full K/V live in VMEM per program);
     - T is not divisible by the (T-clamped) block sizes.
     """
-    if mask is not None or len(q_shape) != 4:
+    if len(q_shape) != 4:
+        return False
+    if mask is not None and tuple(getattr(mask, "shape", ())) != \
+            (q_shape[0], q_shape[2]):
         return False
     if jnp.dtype(dtype) not in (jnp.dtype(jnp.float32),
                                 jnp.dtype(jnp.bfloat16),
@@ -301,12 +365,14 @@ def supports(q_shape, *, mask, dtype=jnp.float32,
     return T % min(block_q, T) == 0 and T % min(block_k, T) == 0
 
 
-def flash_attention(q, k, v, *, causal: bool = False,
+def flash_attention(q, k, v, *, causal: bool = False, mask=None,
                     block_q: int = DEFAULT_BLOCK,
                     block_k: int = DEFAULT_BLOCK, interpret=None):
     """softmax(q k^T / sqrt(d)) v with the flash recurrence.
 
     q/k/v: [B, H, T, d], T divisible by the (T-clamped) block sizes.
+    ``mask``: optional [B, T] key-validity row (1 = attend, 0 = pad),
+    broadcast over heads — same semantics as ``scaled_dot_attention``.
     ``interpret=None`` auto-selects interpreter mode off-TPU (so the same
     call works in the CPU test mesh). Gradients: Pallas recompute-by-block
     backward (dq / dk+dv kernels) from the saved row-logsumexp — O(T)
@@ -316,6 +382,19 @@ def flash_attention(q, k, v, *, causal: bool = False,
     d = q.shape[3]
     block_q = min(block_q, T)
     block_k = min(block_k, T)
+    if mask is not None:
+        B = q.shape[0]
+        if tuple(mask.shape) != (B, T):
+            raise ValueError(
+                f"key mask shape {tuple(mask.shape)} != (B, T) = "
+                f"({B}, {T}) — a [B, T] key-validity row is required")
+        # the in-kernel mask row is dynamically sliced on the LANE dim,
+        # which Mosaic only compiles when the slice start is provably a
+        # multiple of 128 — force a conforming block_k (or one full-row
+        # block; VMEM already holds the full K/V so [1, T] is free)
+        if block_k != T and (block_k % 128 or T % block_k):
+            block_k = next((c for c in range(min(block_k, T) // 128 * 128,
+                                             0, -128) if T % c == 0), T)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     fwd = functools.partial(_flash_forward, causal=causal, block_q=block_q,
@@ -340,17 +419,36 @@ def flash_attention(q, k, v, *, causal: bool = False,
                             block_q=vjp_block_q, block_k=block_k,
                             interpret=interpret)
 
+    if mask is None:
+        @jax.custom_vjp
+        def attn(q, k, v):
+            return fwd(q, k, v, None)[0]
+
+        def attn_fwd(q, k, v):
+            o, lse = vjp_fwd(q, k, v, None)
+            return o, (q, k, v, o, lse)
+
+        def attn_bwd(res, g):
+            q, k, v, o, lse = res
+            return bwd(q, k, v, o, lse, g, None)
+
+        attn.defvjp(attn_fwd, attn_bwd)
+        return attn(q, k, v)
+
+    m = jnp.asarray(mask, jnp.float32)  # float: a bool cotangent is invalid
+
     @jax.custom_vjp
-    def attn(q, k, v):
-        return fwd(q, k, v)[0]
+    def attn_m(q, k, v, m):
+        return fwd(q, k, v, m)[0]
 
-    def attn_fwd(q, k, v):
-        o, lse = vjp_fwd(q, k, v)
-        return o, (q, k, v, o, lse)
+    def attn_m_fwd(q, k, v, m):
+        o, lse = vjp_fwd(q, k, v, m)
+        return o, (q, k, v, m, o, lse)
 
-    def attn_bwd(res, g):
-        q, k, v, o, lse = res
-        return bwd(q, k, v, o, lse, g)
+    def attn_m_bwd(res, g):
+        q, k, v, m, o, lse = res
+        dq, dk, dv = bwd(q, k, v, o, lse, g, m)
+        return dq, dk, dv, jnp.zeros_like(m)
 
-    attn.defvjp(attn_fwd, attn_bwd)
-    return attn(q, k, v)
+    attn_m.defvjp(attn_m_fwd, attn_m_bwd)
+    return attn_m(q, k, v, m)
