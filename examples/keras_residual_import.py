@@ -1,0 +1,81 @@
+"""Import a branched (residual) Keras model and keep training it — the
+dl4j-examples ImportKeras flow extended to functional DAGs
+(KerasModel.java:419-495 / layers/KerasMerge.java parity).
+
+Builds a small residual CNN in Keras, saves legacy h5, imports it as a
+ComputationGraph, checks forward parity against keras.predict, then
+fine-tunes the imported graph on synthetic data.
+
+Run: python examples/keras_residual_import.py
+Env: EXAMPLES_SMOKE=1 shrinks sizes for the test-suite smoke run.
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SMOKE = bool(os.environ.get("EXAMPLES_SMOKE"))
+if SMOKE:  # the smoke run must be hermetic: never touch a real device
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def main():
+    os.environ.setdefault("CUDA_VISIBLE_DEVICES", "")
+    import keras
+    from keras import layers
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.modelimport import import_keras_model_and_weights
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    inp = keras.Input((16, 16, 3), name="in0")
+    x = layers.Conv2D(8, (3, 3), padding="same", activation="relu",
+                      name="c1")(inp)
+    y = layers.Conv2D(8, (3, 3), padding="same", name="c2")(x)
+    z = layers.Add(name="residual_add")([x, y])
+    z = layers.Activation("relu", name="act")(z)
+    z = layers.GlobalAveragePooling2D(name="gap")(z)
+    out = layers.Dense(4, activation="softmax", name="head")(z)
+    km = keras.Model(inp, out)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "residual.h5")
+        km.save(path)
+        net = import_keras_model_and_weights(path)
+    assert isinstance(net, ComputationGraph)
+
+    import jax
+
+    rs = np.random.RandomState(0)
+    xb = rs.randn(8, 16, 16, 3).astype(np.float32)
+    expected = np.asarray(km.predict(xb, verbose=0))
+    # TPU default matmul precision is bf16-multiply; the parity check
+    # needs full precision or the comparison measures the MXU rounding,
+    # not the import
+    with jax.default_matmul_precision("highest"):
+        got = np.asarray(net.output(xb))
+    err = float(np.abs(got - expected).max())
+    print(f"imported {len(net.conf.vertices)}-vertex graph; "
+          f"forward parity max err {err:.2e}")
+    assert err < 1e-4
+
+    # keep training the imported graph (transfer-learning style)
+    n = 64 if SMOKE else 512
+    yb = np.eye(4, dtype=np.float32)[rs.randint(0, 4, n)]
+    data = DataSet(rs.randn(n, 16, 16, 3).astype(np.float32), yb)
+    s0 = net.score(data)
+    for _ in range(2 if SMOKE else 20):
+        net.fit(data)
+    s1 = net.score(data)
+    print(f"fine-tune on imported graph: score {s0:.4f} -> {s1:.4f}")
+    assert s1 < s0
+    print(f"TRAINED iterations: {net.iteration}")
+
+
+if __name__ == "__main__":
+    main()

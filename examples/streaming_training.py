@@ -1,0 +1,117 @@
+"""Train from a live cross-process stream — the dl4j-streaming
+Kafka/Camel route analog (CamelKafkaRouteBuilder.java:16,
+kafka/NDArrayPublisher.java), using the in-repo TCP broker.
+
+A producer PROCESS generates minibatches and publishes them to a broker
+topic; this process subscribes and trains while the frames arrive, with
+bounded-buffer backpressure throttling the producer if training lags.
+
+Run: python examples/streaming_training.py
+Env: EXAMPLES_SMOKE=1 shrinks sizes for the test-suite smoke run.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SMOKE = bool(os.environ.get("EXAMPLES_SMOKE"))
+if SMOKE:  # the smoke run must be hermetic: never touch a real device
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Adam
+from deeplearning4j_tpu.streaming import NDArrayRoute, StreamingBroker
+
+# the producer runs in its OWN python process: only the publisher client
+# and numpy are imported there — it never touches jax or the model
+_PRODUCER = r"""
+import sys
+import numpy as np
+from deeplearning4j_tpu.streaming import NDArrayPublisher
+
+port, n_batches, batch = (int(a) for a in sys.argv[1:4])
+rs = np.random.RandomState(0)
+with NDArrayPublisher("127.0.0.1", port, "spiral") as pub:
+    for i in range(n_batches):
+        # two-class spiral, generated on the fly: the "external source"
+        theta = rs.rand(batch) * 3 * np.pi
+        cls = rs.randint(0, 2, batch)
+        r = theta / (3 * np.pi)
+        x = np.stack([r * np.cos(theta + np.pi * cls),
+                      r * np.sin(theta + np.pi * cls)], 1)
+        x = (x + rs.randn(batch, 2) * 0.02).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[cls]
+        pub.publish_arrays(x, y)
+    pub.end()
+print("producer: published", n_batches, "batches", flush=True)
+"""
+
+
+def main():
+    n_batches = 8 if SMOKE else 400
+    batch = 64
+    broker = StreamingBroker(port=0).start()
+    try:
+        route = NDArrayRoute("127.0.0.1", broker.port, "spiral",
+                             buffer_batches=8)
+        env = dict(os.environ,
+                   PYTHONPATH=os.pathsep.join(sys.path))
+        producer = subprocess.Popen(
+            [sys.executable, "-c", _PRODUCER, str(broker.port),
+             str(n_batches), str(batch)], env=env)
+
+        conf = (NeuralNetConfiguration.builder()
+                .seed(7).updater(Adam(learning_rate=3e-3))
+                .list(DenseLayer(n_out=64, activation="relu"),
+                      DenseLayer(n_out=64, activation="relu"),
+                      OutputLayer(n_out=2, activation="softmax",
+                                  loss="mcxent"))
+                .set_input_type(InputType.feed_forward(2)).build())
+        net = MultiLayerNetwork(conf).init()
+
+        def unblock_on_producer_crash():
+            # a producer that dies without sending END would leave fit()
+            # blocked on the queue forever; close the stream in its stead
+            if producer.wait() != 0:
+                route.iterator().end()
+
+        threading.Thread(target=unblock_on_producer_crash,
+                         daemon=True).start()
+        try:
+            net.fit(route.iterator())  # trains WHILE the producer publishes
+            assert producer.wait(120) == 0
+        finally:
+            if producer.poll() is None:  # crashed-consumer path: don't
+                producer.kill()          # leak the child process
+                producer.wait()
+
+        # held-out accuracy on freshly generated spiral points
+        rs = np.random.RandomState(9)
+        theta = rs.rand(512) * 3 * np.pi
+        cls = rs.randint(0, 2, 512)
+        r = theta / (3 * np.pi)
+        x = np.stack([r * np.cos(theta + np.pi * cls),
+                      r * np.sin(theta + np.pi * cls)], 1).astype(np.float32)
+        pred = np.asarray(net.output(x)).argmax(1)
+        acc = float((pred == cls).mean())
+        print(f"trained on {net.iteration} streamed batches; "
+              f"held-out accuracy {acc:.3f}")
+        print(f"TRAINED iterations: {net.iteration}")
+        assert net.iteration == n_batches
+        if not SMOKE:
+            assert acc > 0.85, acc
+    finally:
+        broker.stop()
+
+
+if __name__ == "__main__":
+    main()
