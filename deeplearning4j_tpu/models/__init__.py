@@ -9,6 +9,7 @@ from deeplearning4j_tpu.models.zoo import (
     ResNet50,
     SimpleCNN,
     TextGenerationLSTM,
+    TransformerLM,
     VGG16,
     VGG19,
     ZooModel,
@@ -17,6 +18,6 @@ from deeplearning4j_tpu.models.zoo import (
 
 __all__ = [
     "AlexNet", "FaceNetNN4Small2", "GoogLeNet", "InceptionResNetV1", "LeNet",
-    "ResNet50", "SimpleCNN", "TextGenerationLSTM", "VGG16", "VGG19",
+    "ResNet50", "SimpleCNN", "TextGenerationLSTM", "TransformerLM", "VGG16", "VGG19",
     "ZooModel", "zoo_models",
 ]

@@ -34,9 +34,14 @@ from deeplearning4j_tpu.nn.conf.layers.core import (
     DropoutLayer,
     OutputLayer,
 )
+from deeplearning4j_tpu.nn.conf.layers.attention import (
+    PositionalEncodingLayer,
+    SelfAttentionLayer,
+)
 from deeplearning4j_tpu.nn.conf.layers.misc import CenterLossOutputLayer
 from deeplearning4j_tpu.nn.conf.layers.normalization import (
     BatchNormalization,
+    LayerNormalization,
     LocalResponseNormalization,
 )
 from deeplearning4j_tpu.nn.conf.layers.pooling import GlobalPoolingLayer
@@ -48,6 +53,7 @@ from deeplearning4j_tpu.nn.graph import ComputationGraph
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.nn.updater import (
     AdaDelta,
+    Adam,
     Nesterovs,
     RmsProp,
 )
@@ -755,8 +761,73 @@ class TextGenerationLSTM(ZooModel):
                 .build())
 
 
+class TransformerLM(ZooModel):
+    """Causal transformer language model (beyond reference parity — the
+    2017-era zoo's sequence model is TextGenerationLSTM; this is its
+    modern sibling, built from the same framework pieces so the flash
+    attention path has a model-level consumer).
+
+    Pre-norm residual blocks as a ComputationGraph: one-hot tokens ->
+    Dense embed + sinusoidal positions -> n_blocks x [LN -> causal
+    multi-head SelfAttention (helper='auto': Pallas flash kernel when
+    supported) -> +residual -> LN -> Dense(4D, gelu) -> Dense(D) ->
+    +residual] -> LN -> RnnOutputLayer softmax/mcxent per timestep.
+    """
+
+    def __init__(self, num_labels: int = 256, max_length: int = 128,
+                 d_model: int = 256, n_heads: int = 8, n_blocks: int = 4,
+                 **kw):
+        super().__init__(num_labels=num_labels, **kw)
+        self.max_length = max_length
+        self.d_model = d_model
+        self.n_heads = n_heads
+        self.n_blocks = n_blocks
+        self.input_shape = (max_length, num_labels)
+
+    def conf(self):
+        D = self.d_model
+        g = (NeuralNetConfiguration.builder()
+             .seed(self.seed).weight_init("xavier")
+             .updater(Adam(learning_rate=3e-4))
+             .dtype(self.dtype)
+             .graph_builder()
+             .add_inputs("tokens")
+             .set_input_types(InputType.recurrent(self.num_labels,
+                                                  self.max_length)))
+        g.add_layer("embed", DenseLayer(n_out=D, activation="identity"),
+                    "tokens")
+        g.add_layer("pos", PositionalEncodingLayer(), "embed")
+        x = "pos"
+        for i in range(self.n_blocks):
+            g.add_layer(f"ln{i}a", LayerNormalization(), x)
+            g.add_layer(f"attn{i}",
+                        SelfAttentionLayer(n_out=D, n_heads=self.n_heads,
+                                           causal=True, helper="auto"),
+                        f"ln{i}a")
+            g.add_vertex(f"res{i}a", ElementWiseVertex(op="add"),
+                         x, f"attn{i}")
+            g.add_layer(f"ln{i}b", LayerNormalization(), f"res{i}a")
+            g.add_layer(f"ff{i}a", DenseLayer(n_out=4 * D,
+                                              activation="gelu"),
+                        f"ln{i}b")
+            g.add_layer(f"ff{i}b", DenseLayer(n_out=D,
+                                              activation="identity"),
+                        f"ff{i}a")
+            g.add_vertex(f"res{i}b", ElementWiseVertex(op="add"),
+                         f"res{i}a", f"ff{i}b")
+            x = f"res{i}b"
+        g.add_layer("ln_f", LayerNormalization(), x)
+        g.add_layer("output",
+                    RnnOutputLayer(n_out=self.num_labels,
+                                   activation="softmax", loss="mcxent"),
+                    "ln_f")
+        g.set_outputs("output")
+        return g.build()
+
+
 def zoo_models() -> dict:
-    """Name -> ZooModel class registry (reference: zoo/ModelSelector.java)."""
+    """Name -> ZooModel class registry (reference: zoo/ModelSelector.java;
+    ``transformerlm`` is beyond-parity)."""
     return {
         "alexnet": AlexNet,
         "facenetnn4small2": FaceNetNN4Small2,
@@ -766,6 +837,7 @@ def zoo_models() -> dict:
         "resnet50": ResNet50,
         "simplecnn": SimpleCNN,
         "textgenlstm": TextGenerationLSTM,
+        "transformerlm": TransformerLM,
         "vgg16": VGG16,
         "vgg19": VGG19,
     }

@@ -30,6 +30,7 @@ from deeplearning4j_tpu.nn.conf.layers.convolution import (
 )
 from deeplearning4j_tpu.nn.conf.layers.normalization import (
     BatchNormalization,
+    LayerNormalization,
     LocalResponseNormalization,
 )
 from deeplearning4j_tpu.nn.conf.layers.pooling import GlobalPoolingLayer, PoolingType
@@ -55,3 +56,7 @@ from deeplearning4j_tpu.nn.conf.layers.misc import (
 )
 from deeplearning4j_tpu.nn.conf.layers.rbm import RBM
 from deeplearning4j_tpu.nn.conf.layers.moe import MixtureOfExpertsLayer
+from deeplearning4j_tpu.nn.conf.layers.attention import (
+    PositionalEncodingLayer,
+    SelfAttentionLayer,
+)

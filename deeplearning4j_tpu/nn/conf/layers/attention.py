@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.nn.conf.inputs import InputType
-from deeplearning4j_tpu.nn.conf.layers.base import BaseLayer
+from deeplearning4j_tpu.nn.conf.layers.base import BaseLayer, Layer
 from deeplearning4j_tpu.utils.serde import register_serializable
 
 NEG_INF = -1e30
@@ -122,3 +122,33 @@ class SelfAttentionLayer(BaseLayer):
         if mask is not None:
             out = out * mask.astype(out.dtype)[:, :, None]
         return self.act()(out), state
+
+
+@register_serializable
+@dataclass
+class PositionalEncodingLayer(Layer):
+    """Add the fixed sinusoidal position table to a [B, T, F] sequence
+    (Vaswani et al. encoding; parameterless, so serde is trivial and the
+    table is a compile-time constant folded into the XLA program).
+
+    Beyond reference parity: exists (with LayerNormalization) so
+    transformer stacks are buildable first-class — the 2017-era reference
+    predates them.
+    """
+
+    max_wavelength: float = 10000.0
+
+    INPUT_KIND = "rnn"
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def forward(self, params, state, x, *, mask=None, train=False, rng=None):
+        T, F = x.shape[-2], x.shape[-1]
+        pos = jnp.arange(T, dtype=jnp.float32)[:, None]
+        half = (F + 1) // 2
+        freq = jnp.exp(-jnp.log(self.max_wavelength)
+                       * jnp.arange(half, dtype=jnp.float32) / max(half, 1))
+        ang = pos * freq[None, :]                       # [T, half]
+        pe = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)[:, :F]
+        return x + pe.astype(x.dtype), state

@@ -111,3 +111,54 @@ class LocalResponseNormalization(Layer):
         ssum = lax.reduce_window(sq, 0.0, lax.add, window, strides, padding)
         denom = (self.k + self.alpha * ssum) ** self.beta
         return x / denom, state
+
+
+@register_serializable
+@dataclass
+class LayerNormalization(BaseLayer):
+    """Per-example normalization over the feature (last) axis with learned
+    gamma/beta — no running stats, identical in train and eval.
+
+    Beyond reference parity: the 2017-era reference has no LayerNorm (its
+    normalizers are BatchNormalization.java and LRN); this layer exists so
+    transformer stacks (SelfAttentionLayer + residual blocks, zoo
+    TransformerLM) are buildable first-class. gamma/beta are never
+    weight-decayed, matching the BatchNormalization convention above.
+    """
+
+    n_out: int = 0
+    eps: float = 1e-5
+    gamma_init: float = 1.0
+    beta_init: float = 0.0
+
+    DEFAULT_ACTIVATION = "identity"
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if self.n_out == 0:
+            if input_type.kind == "convolutional":
+                self.n_out = input_type.channels
+            else:
+                self.n_out = input_type.size
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def param_order(self):
+        return ["gamma", "beta"]
+
+    def regularization(self, params: dict):
+        return 0.0  # gamma/beta never decayed (BatchNormalization parity)
+
+    def regularization_grad(self, params: dict) -> dict:
+        return {}
+
+    def init_params(self, rng, dtype=jnp.float32):
+        return {"gamma": jnp.full((self.n_out,), self.gamma_init, dtype),
+                "beta": jnp.full((self.n_out,), self.beta_init, dtype)}
+
+    def forward(self, params, state, x, *, mask=None, train=False, rng=None):
+        x = self.apply_input_dropout(x, train=train, rng=rng)
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.mean((x - mean) ** 2, axis=-1, keepdims=True)
+        xhat = (x - mean) * lax.rsqrt(var + self.eps)
+        return self.act()(xhat * params["gamma"] + params["beta"]), state

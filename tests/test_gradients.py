@@ -126,6 +126,36 @@ def test_batchnorm_gradients():
     assert check_gradients(net, x, y, verbose=True)
 
 
+def test_layernorm_gradients():
+    from deeplearning4j_tpu.nn.conf.layers import LayerNormalization
+
+    rng = np.random.default_rng(15)
+    net = _build([DenseLayer(n_out=5, activation="tanh"),
+                  LayerNormalization(),
+                  OutputLayer(n_out=3, loss="mcxent", activation="softmax")],
+                 InputType.feed_forward(4))
+    x = rng.normal(0, 1, (6, 4))
+    y = _onehot(rng, 6, 3)
+    assert check_gradients(net, x, y, verbose=True)
+
+
+def test_layernorm_sequence_gradients():
+    from deeplearning4j_tpu.nn.conf.layers import (LayerNormalization,
+                                                   PositionalEncodingLayer)
+
+    rng = np.random.default_rng(16)
+    net = _build([PositionalEncodingLayer(),
+                  SimpleRnn(n_out=5, activation="tanh"),
+                  LayerNormalization(),
+                  RnnOutputLayer(n_out=2, loss="mcxent",
+                                 activation="softmax")],
+                 InputType.recurrent(3, 4))
+    x = rng.normal(0, 1, (2, 4, 3))
+    y = np.zeros((2, 4, 2))
+    y[..., 0] = 1
+    assert check_gradients(net, x, y, verbose=True)
+
+
 def test_lrn_gradients():
     rng = np.random.default_rng(6)
     net = _build([ConvolutionLayer(n_out=4, kernel_size=(2, 2), activation="tanh"),
@@ -239,3 +269,53 @@ def test_moe_load_balance_term_trains():
     x = rng.normal(0, 1, (6, 4))
     y = _onehot(rng, 6, 3)
     assert check_gradients(net, x, y, train=False)
+
+
+def test_layernorm_semantics_and_serde():
+    """LayerNormalization: per-example last-axis normalization (mean 0,
+    var 1 pre-affine), train == eval, JSON round trip."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.conf.layers import LayerNormalization
+    from deeplearning4j_tpu.utils.serde import from_json, to_json
+
+    lyr = LayerNormalization(n_out=8)
+    params = lyr.init_params(jax.random.PRNGKey(0), dtype=jnp.float64)
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(3.0, 5.0, (4, 6, 8)))
+    out_train, _ = lyr.forward(params, {}, x, train=True)
+    out_eval, _ = lyr.forward(params, {}, x, train=False)
+    np.testing.assert_array_equal(np.asarray(out_train),
+                                  np.asarray(out_eval))  # no running stats
+    np.testing.assert_allclose(np.asarray(out_train).mean(-1), 0.0,
+                               atol=1e-10)
+    np.testing.assert_allclose(np.asarray(out_train).std(-1), 1.0,
+                               atol=1e-4)
+    back = from_json(to_json(LayerNormalization(n_out=8, eps=1e-3)))
+    assert back == LayerNormalization(n_out=8, eps=1e-3)
+
+
+def test_positional_encoding_semantics():
+    """Sinusoidal table: deterministic, position-distinguishing, additive
+    (zero input returns the table itself), serde round trip."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.conf.layers import PositionalEncodingLayer
+    from deeplearning4j_tpu.utils.serde import from_json, to_json
+
+    lyr = PositionalEncodingLayer()
+    z = jnp.zeros((1, 12, 16))
+    pe, _ = lyr.forward({}, {}, z)
+    pe = np.asarray(pe)[0]
+    # rows are pairwise distinct (positions distinguishable)
+    for i in range(12):
+        for j in range(i + 1, 12):
+            assert np.abs(pe[i] - pe[j]).max() > 1e-3
+    # additive: forward(x) == x + forward(0)
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(0, 1, (2, 12, 16)), jnp.float32)
+    out, _ = lyr.forward({}, {}, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x) + pe,
+                               atol=1e-6)
+    assert from_json(to_json(lyr)) == lyr
