@@ -25,7 +25,7 @@ img/s on the 2017 GPUs the reference targeted (K80/GTX1080 class) => target
 met; >1 beats it.
 
 Usage: python bench.py [model]   (model: resnet50 | vgg16 | lenet | lstm |
-word2vec | doc2vec | attention | all; default all, headline = resnet50)
+transformer | word2vec | doc2vec | attention | all; default all, headline = resnet50)
 """
 
 from __future__ import annotations
@@ -237,6 +237,24 @@ def bench_lstm(batch: int = 64, seq: int = 50, vocab: int = 77,
     return med * seq, [round(w * seq, 1) for w in windows]
 
 
+def bench_transformer_lm(batch: int = 32, seq: int = 512, vocab: int = 256,
+                         steps: int = 10, k_windows: int = 5):
+    """Causal TransformerLM training throughput, tokens/s (beyond-parity
+    model: pre-norm residual blocks whose attention routes through the
+    Pallas flash kernel; bf16 compute)."""
+    from deeplearning4j_tpu.models import TransformerLM
+
+    net = TransformerLM(num_labels=vocab, max_length=seq, d_model=256,
+                        n_heads=8, n_blocks=4, seed=0,
+                        compute_dtype="bfloat16").init()
+    rs = np.random.RandomState(6)
+    idx = rs.randint(0, vocab, (batch, seq + 1))
+    x = np.eye(vocab, dtype=np.float32)[idx[:, :-1]]
+    y = np.eye(vocab, dtype=np.float32)[idx[:, 1:]]
+    med, windows = _steady_state_img_s(net, x, y, steps, k_windows)
+    return med * seq, [round(w * seq, 1) for w in windows]
+
+
 def bench_attention(B: int = 4, H: int = 8, T: int = 4096, d: int = 128,
                     steps: int = 30):
     """Pallas flash-attention kernel vs stock XLA attention (the
@@ -393,6 +411,7 @@ SANITY_CEILING = {
     "lenet_mnist_img_s": 1e8,
     "vgg16_bf16_img_s": 1e5,
     "textgen_lstm_tokens_s": 1e9,
+    "transformer_lm_tokens_s": 1e9,
     "word2vec_words_s": 1e8,
     "doc2vec_words_s": 1e8,
     "resnet50_bf16_img_s": 1e5,
@@ -415,6 +434,7 @@ METRIC_UNIT = {
     "lenet_mnist_img_s": "img/s",
     "vgg16_bf16_img_s": "img/s",
     "textgen_lstm_tokens_s": "tokens/s",
+    "transformer_lm_tokens_s": "tokens/s",
     "word2vec_words_s": "words/s",
     "doc2vec_words_s": "words/s",
     "resnet50_bf16_img_s": "img/s",
@@ -633,8 +653,8 @@ class _HeadlineSampler:
 
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
-    valid = ("all", "resnet50", "vgg16", "lenet", "lstm", "word2vec", "doc2vec",
-             "attention")
+    valid = ("all", "resnet50", "vgg16", "lenet", "lstm", "transformer",
+             "word2vec", "doc2vec", "attention")
     if which not in valid:
         sys.exit(f"Unknown model '{which}'; choose one of {valid}")
     # persistent XLA compile cache: repeated bench runs skip the
@@ -667,6 +687,9 @@ def main():
     if which in ("all", "lstm"):
         _sub_metric(extras, "textgen_lstm_tokens_s", bench_lstm)
         headline and headline.sample("post-lstm")
+    if which in ("all", "transformer"):
+        _sub_metric(extras, "transformer_lm_tokens_s", bench_transformer_lm)
+        headline and headline.sample("post-transformer")
     if which in ("all", "word2vec"):
         _sub_metric(extras, "word2vec_words_s", bench_word2vec)
         headline and headline.sample("post-word2vec")

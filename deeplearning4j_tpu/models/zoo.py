@@ -824,6 +824,9 @@ class TransformerLM(ZooModel):
         g.set_outputs("output")
         return g.build()
 
+    def model_type(self) -> str:
+        return "ComputationGraph"
+
 
 def zoo_models() -> dict:
     """Name -> ZooModel class registry (reference: zoo/ModelSelector.java;

@@ -18,23 +18,17 @@ from deeplearning4j_tpu.nn.conf.layers.base import BaseLayer, Layer
 from deeplearning4j_tpu.utils.serde import register_serializable
 
 
-@register_serializable
 @dataclass
-class BatchNormalization(BaseLayer):
-    """Batch norm over the feature (last) axis; works for [B,F], [B,T,F], [B,H,W,C].
-
-    Running-stat update matches the reference: global = decay*global + (1-decay)*batch
-    (nn/layers/normalization/BatchNormalization.java). gamma/beta trainable unless
-    ``lock_gamma_beta``.
-    """
+class _FeatureAffineNorm(BaseLayer):
+    """Shared base for feature-axis normalizers with learned gamma/beta:
+    nIn inference (channels for conv inputs, size otherwise), shape
+    passthrough, and the never-weight-decayed convention (reference:
+    BatchNormalization.java:70-76 calcL1/calcL2 -> 0)."""
 
     n_out: int = 0
-    decay: float = 0.9
     eps: float = 1e-5
     gamma_init: float = 1.0
     beta_init: float = 0.0
-    lock_gamma_beta: bool = False
-    minibatch_stats: bool = True  # use minibatch stats in training (ref: isMinibatch)
 
     DEFAULT_ACTIVATION = "identity"
 
@@ -49,21 +43,40 @@ class BatchNormalization(BaseLayer):
         return input_type
 
     def param_order(self):
-        return [] if self.lock_gamma_beta else ["gamma", "beta"]
+        return ["gamma", "beta"]
 
     def regularization(self, params: dict):
-        # gamma/beta are never weight-decayed (reference:
-        # nn/layers/normalization/BatchNormalization.java:70-76 calcL1/calcL2 -> 0)
-        return 0.0
+        return 0.0  # gamma/beta never decayed
 
     def regularization_grad(self, params: dict) -> dict:
         return {}  # mirrors regularization() == 0
 
     def init_params(self, rng, dtype=jnp.float32):
-        if self.lock_gamma_beta:
-            return {}
         return {"gamma": jnp.full((self.n_out,), self.gamma_init, dtype),
                 "beta": jnp.full((self.n_out,), self.beta_init, dtype)}
+
+
+@register_serializable
+@dataclass
+class BatchNormalization(_FeatureAffineNorm):
+    """Batch norm over the feature (last) axis; works for [B,F], [B,T,F], [B,H,W,C].
+
+    Running-stat update matches the reference: global = decay*global + (1-decay)*batch
+    (nn/layers/normalization/BatchNormalization.java). gamma/beta trainable unless
+    ``lock_gamma_beta``.
+    """
+
+    decay: float = 0.9
+    lock_gamma_beta: bool = False
+    minibatch_stats: bool = True  # use minibatch stats in training (ref: isMinibatch)
+
+    def param_order(self):
+        return [] if self.lock_gamma_beta else ["gamma", "beta"]
+
+    def init_params(self, rng, dtype=jnp.float32):
+        if self.lock_gamma_beta:
+            return {}
+        return super().init_params(rng, dtype)
 
     def init_state(self, dtype=jnp.float32):
         return {"mean": jnp.zeros((self.n_out,), dtype),
@@ -115,46 +128,17 @@ class LocalResponseNormalization(Layer):
 
 @register_serializable
 @dataclass
-class LayerNormalization(BaseLayer):
+class LayerNormalization(_FeatureAffineNorm):
     """Per-example normalization over the feature (last) axis with learned
     gamma/beta — no running stats, identical in train and eval.
 
     Beyond reference parity: the 2017-era reference has no LayerNorm (its
     normalizers are BatchNormalization.java and LRN); this layer exists so
     transformer stacks (SelfAttentionLayer + residual blocks, zoo
-    TransformerLM) are buildable first-class. gamma/beta are never
-    weight-decayed, matching the BatchNormalization convention above.
+    TransformerLM) are buildable first-class. Shares the nIn-inference and
+    never-weight-decayed gamma/beta convention with BatchNormalization via
+    ``_FeatureAffineNorm``.
     """
-
-    n_out: int = 0
-    eps: float = 1e-5
-    gamma_init: float = 1.0
-    beta_init: float = 0.0
-
-    DEFAULT_ACTIVATION = "identity"
-
-    def set_n_in(self, input_type: InputType) -> None:
-        if self.n_out == 0:
-            if input_type.kind == "convolutional":
-                self.n_out = input_type.channels
-            else:
-                self.n_out = input_type.size
-
-    def output_type(self, input_type: InputType) -> InputType:
-        return input_type
-
-    def param_order(self):
-        return ["gamma", "beta"]
-
-    def regularization(self, params: dict):
-        return 0.0  # gamma/beta never decayed (BatchNormalization parity)
-
-    def regularization_grad(self, params: dict) -> dict:
-        return {}
-
-    def init_params(self, rng, dtype=jnp.float32):
-        return {"gamma": jnp.full((self.n_out,), self.gamma_init, dtype),
-                "beta": jnp.full((self.n_out,), self.beta_init, dtype)}
 
     def forward(self, params, state, x, *, mask=None, train=False, rng=None):
         x = self.apply_input_dropout(x, train=train, rng=rng)
