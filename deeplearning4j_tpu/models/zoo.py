@@ -828,6 +828,111 @@ class TransformerLM(ZooModel):
         return "ComputationGraph"
 
 
+def greedy_generate(net, prompt_ids, steps: int, vocab: int,
+                    device_loop: bool = True):
+    """Greedy autoregressive decoding via KV-cache streaming: the prompt
+    is consumed once, then each new token costs ONE incremental
+    attention row (cached keys/values — O(T) per token) instead of a
+    full O(T^2) re-forward. Works with any one-hot-input causal LM
+    (TransformerLM; TextGenerationLSTM streams through its h/c the same
+    way).
+
+    ``device_loop=True`` (default) compiles the WHOLE decode as one XLA
+    program — a ``lax.scan`` whose body is forward + argmax + one-hot
+    feedback — so the host pays a single dispatch instead of one
+    round-trip per token (measured ~115 ms/token of pure tunnel latency
+    on the CI chip). ``device_loop=False`` streams through
+    ``rnn_time_step`` one token at a time (same math, host-driven).
+
+    prompt_ids: [B, T0] int array. Returns [B, steps] generated ids.
+    """
+    import numpy as np_
+
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
+    prompt_ids = np_.asarray(prompt_ids)
+    if device_loop:
+        return np_.asarray(_device_greedy_generate(net, prompt_ids, steps,
+                                                   vocab))
+    eye = np_.eye(vocab, dtype=np_.float32)
+    net.rnn_clear_previous_state()
+    out = net.rnn_time_step(eye[prompt_ids])          # [B, T0, V]
+    last = np_.asarray(out)[:, -1].argmax(-1)         # [B]
+    generated = [last]
+    for _ in range(steps - 1):
+        out = net.rnn_time_step(eye[last][:, None, :])  # [B, 1, V]
+        last = np_.asarray(out)[:, 0].argmax(-1)
+        generated.append(last)
+    return np_.stack(generated, axis=1)
+
+
+def _device_greedy_generate(net, prompt_ids, steps: int, vocab: int):
+    """One jitted program: consume the prompt, then lax.scan the
+    token-by-token decode on device (KV caches ride in the scan carry)."""
+    import jax
+    import jax.numpy as jnp
+
+    is_graph = hasattr(net.conf, "network_inputs")
+    B = prompt_ids.shape[0]
+    # generation is its own stream: any live rnn_time_step stream is
+    # CLEARED (seeding below resets the overflow accounting, so leaving
+    # the old carry in place would let a continued stream bypass the
+    # guard and silently clamp-corrupt its cache)
+    net.rnn_clear_previous_state()
+    carry0 = net._seed_streaming_carry(B)
+    cap = net._stream_capacity
+    needed = prompt_ids.shape[1] + steps - 1
+    if cap is not None and needed > cap:
+        raise ValueError(
+            f"KV cache overflow: prompt + generated positions ({needed}) "
+            f"> max_cache ({cap}); raise SelfAttentionLayer.max_cache")
+
+    # one compiled program per (shapes, steps): cached on the net like
+    # rnn_time_step's step fn — a serving loop must not re-trace the
+    # whole scan program per request
+    key = ("greedy_generate", B, prompt_ids.shape[1], steps, vocab)
+    if key not in net._output_cache:
+        def fwd(params, state, x, carry):
+            if is_graph:
+                outs, _, new_carry, _, _ = net._forward(
+                    params, state, [x], [None], train=False, rng=None,
+                    carry=carry)
+                return outs[0], new_carry
+            out, _, new_carry, _ = net._forward(params, state, x, None,
+                                                train=False, rng=None,
+                                                carry=carry)
+            return out, new_carry
+
+        def generate(params, state, prompt_onehot, carry):
+            out, carry = fwd(params, state, prompt_onehot, carry)
+            last = jnp.argmax(out[:, -1], axis=-1)
+            if steps == 1:
+                return last[:, None]
+
+            def body(c, _):
+                carry, last = c
+                x = jax.nn.one_hot(last, vocab,
+                                   dtype=prompt_onehot.dtype)[:, None, :]
+                o, carry = fwd(params, state, x, carry)
+                nxt = jnp.argmax(o[:, 0], axis=-1)
+                return (carry, nxt), nxt
+
+            (_, _), rest = jax.lax.scan(body, (carry, last), None,
+                                        length=steps - 1)
+            return jnp.concatenate([last[:, None],
+                                    jnp.moveaxis(rest, 0, 1)], axis=1)
+
+        net._output_cache[key] = jax.jit(generate)
+
+    eye = jnp.eye(vocab, dtype=jnp.dtype(net.conf.dtype))
+    out = net._output_cache[key](net.params, net.state, eye[prompt_ids],
+                                 carry0)
+    # the generation stream's carry lived only inside the program;
+    # leave the net with no half-open stream
+    net.rnn_clear_previous_state()
+    return out
+
+
 def zoo_models() -> dict:
     """Name -> ZooModel class registry (reference: zoo/ModelSelector.java;
     ``transformerlm`` is beyond-parity)."""

@@ -53,6 +53,9 @@ class SelfAttentionLayer(BaseLayer):
     n_heads: int = 1
     causal: bool = False
     project_input: bool = True
+    # KV-cache capacity for streaming decode (rnn_time_step); caches are
+    # allocated lazily per stream, so this costs nothing until streaming
+    max_cache: int = 512
     # Accelerated-kernel switch (the AlgoMode / cuDNN-helper analog,
     # reference: ConvolutionLayer.java:68-79 reflective helper load):
     # "auto" uses the Pallas flash kernel whenever it supports the case
@@ -111,6 +114,8 @@ class SelfAttentionLayer(BaseLayer):
         return scaled_dot_attention(q, k, v, causal=self.causal, mask=mask)
 
     def forward(self, params, state, x, *, mask=None, train=False, rng=None):
+        if "kcache" in state:
+            return self._streaming_forward(params, state, x)
         x = self.apply_input_dropout(x, train=train, rng=rng)
         q = self._split_heads(jnp.einsum("btf,fo->bto", x, params["Wq"]))
         k = self._split_heads(jnp.einsum("btf,fo->bto", x, params["Wk"]))
@@ -122,6 +127,58 @@ class SelfAttentionLayer(BaseLayer):
         if mask is not None:
             out = out * mask.astype(out.dtype)[:, :, None]
         return self.act()(out), state
+
+    # ------------------------------------------------- streaming decode
+    def init_streaming_carry(self, batch: int, dtype=jnp.float32) -> dict:
+        """KV cache for incremental decode (the transformer analog of the
+        LSTM's h/c streaming state behind rnnTimeStep): keys/values of
+        already-consumed positions stay cached, so each new token costs
+        one attention row instead of a full O(T^2) re-forward. Only
+        causal layers can stream — a non-causal layer would need future
+        tokens — so they return no carry (per-chunk attention then
+        applies, matching the pre-cache behavior)."""
+        if not self.causal:
+            return {}
+        H = self.n_heads
+        d = self.n_out // H
+        return {
+            "kcache": jnp.zeros((batch, H, self.max_cache, d), dtype),
+            "vcache": jnp.zeros((batch, H, self.max_cache, d), dtype),
+            "cache_pos": jnp.zeros((), jnp.int32),
+        }
+
+    def _streaming_forward(self, params, state, x):
+        B, T, _ = x.shape
+        kc, vc, pos = state["kcache"], state["vcache"], state["cache_pos"]
+        Tmax = kc.shape[2]
+        if not isinstance(pos, jax.core.Tracer) and int(pos) + T > Tmax:
+            raise ValueError(
+                f"KV cache overflow: position {int(pos)} + {T} new tokens "
+                f"> max_cache {Tmax}; raise SelfAttentionLayer.max_cache "
+                "or rnn_clear_previous_state() to start a new stream")
+        q = self._split_heads(jnp.einsum("btf,fo->bto", x, params["Wq"]))
+        k = self._split_heads(jnp.einsum("btf,fo->bto", x, params["Wk"]))
+        v = self._split_heads(jnp.einsum("btf,fo->bto", x, params["Wv"]))
+        z = jnp.zeros((), jnp.int32)  # index dtypes must all match pos's
+        kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
+                                          (z, z, pos, z))
+        vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
+                                          (z, z, pos, z))
+        d = q.shape[-1]
+        logits = jnp.einsum("bhtd,bhkd->bhtk", q, kc) / jnp.sqrt(
+            jnp.asarray(d, q.dtype))
+        col = jnp.arange(Tmax)[None, None, None, :]
+        row = jnp.arange(T)[None, None, :, None]
+        logits = jnp.where(col <= pos + row, logits, NEG_INF)
+        o = jnp.einsum("bhtk,bhkd->bhtd",
+                       jax.nn.softmax(logits, axis=-1), vc)
+        o = o.transpose(0, 2, 1, 3).reshape(B, T, self.n_out)
+        out = jnp.einsum("bto,op->btp", o, params["Wo"]) + params["b"]
+        new_state = dict(state)
+        new_state["kcache"] = kc
+        new_state["vcache"] = vc
+        new_state["cache_pos"] = pos + T
+        return self.act()(out), new_state
 
 
 @register_serializable
@@ -143,12 +200,24 @@ class PositionalEncodingLayer(Layer):
     def output_type(self, input_type: InputType) -> InputType:
         return input_type
 
+    def init_streaming_carry(self, batch: int, dtype=jnp.float32) -> dict:
+        # streaming decode: chunk t must receive the encoding of its
+        # ABSOLUTE position, so the consumed-token count is carried
+        return {"cache_pos": jnp.zeros((), jnp.int32)}
+
     def forward(self, params, state, x, *, mask=None, train=False, rng=None):
         T, F = x.shape[-2], x.shape[-1]
-        pos = jnp.arange(T, dtype=jnp.float32)[:, None]
+        start = state.get("cache_pos")
+        pos = jnp.arange(T, dtype=jnp.float32)[:, None] \
+            + (0.0 if start is None else start.astype(jnp.float32))
         half = (F + 1) // 2
         freq = jnp.exp(-jnp.log(self.max_wavelength)
                        * jnp.arange(half, dtype=jnp.float32) / max(half, 1))
         ang = pos * freq[None, :]                       # [T, half]
         pe = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)[:, :F]
-        return x + pe.astype(x.dtype), state
+        out = x + pe.astype(x.dtype)
+        if start is None:
+            return out, state
+        new_state = dict(state)
+        new_state["cache_pos"] = start + T
+        return out, new_state

@@ -106,6 +106,13 @@ class Layer:
     def init_state(self, dtype=jnp.float32) -> dict:
         return {}
 
+    def init_streaming_carry(self, batch: int, dtype=jnp.float32) -> dict:
+        """Initial carry for streaming inference (rnn_time_step). LSTMs
+        need none (their h/c default lazily to zeros); attention layers
+        return a KV cache here so incremental decode is O(T) per token
+        instead of re-running the full O(T^2) forward."""
+        return {}
+
     def has_params(self) -> bool:
         return bool(self.param_order())
 

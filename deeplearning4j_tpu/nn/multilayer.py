@@ -36,11 +36,16 @@ from deeplearning4j_tpu.nn.gradient_normalization import (
 )
 from deeplearning4j_tpu.utils.pytree import flatten_params, unflatten_params
 
-_RNN_KEYS = ("h", "c")
+_RNN_KEYS = ("h", "c", "kcache", "vcache", "cache_pos")
 
 
 def _split_state(state):
-    """Split a layer-state dict into (persistent, rnn-carry) parts."""
+    """Split a layer-state dict into (persistent, rnn-carry) parts.
+
+    h/c: recurrent hidden state (LSTM family). kcache/vcache/cache_pos:
+    attention KV-cache streaming state (SelfAttentionLayer /
+    PositionalEncodingLayer incremental decode) — present only when a
+    streaming carry was seeded by rnn_time_step, never during training."""
     persistent, carry = {}, {}
     for k, v in state.items():
         (carry if k in _RNN_KEYS else persistent)[k] = v
@@ -61,6 +66,8 @@ class MultiLayerNetwork:
         self._step_cache: dict = {}
         self._output_cache: dict = {}
         self._rnn_state: Optional[dict] = None  # streaming rnnTimeStep state
+        self._stream_pos = 0              # tokens consumed this stream
+        self._stream_capacity = None      # min attention max_cache, if any
         out = self.layers[-1] if self.layers else None
         self._has_loss_head = hasattr(out, "compute_loss_per_example")
 
@@ -362,6 +369,8 @@ class MultiLayerNetwork:
     # ------------------------------------------------------- rnn streaming
     def rnn_clear_previous_state(self):
         self._rnn_state = None
+        self._stream_pos = 0
+        self._stream_capacity = None
 
     def rnn_time_step(self, x):
         """Streaming single/multi-step inference with persistent state (reference:
@@ -371,11 +380,52 @@ class MultiLayerNetwork:
         if x.ndim == 2:  # [B, F] -> single timestep
             x = x[:, None, :]
             squeeze = True
+        if self._rnn_state is None:
+            # fresh stream: layers that stream through explicit caches
+            # (attention KV caches) seed their carry here; LSTMs need
+            # nothing (h/c default lazily to zeros)
+            self._rnn_state = self._seed_streaming_carry(x.shape[0])
+        # overflow must be caught HERE (static position accounting): the
+        # jitted step's cache_pos is a tracer, and dynamic_update_slice
+        # would silently clamp and corrupt the cache tail
+        if self._stream_capacity is not None and \
+                self._stream_pos + x.shape[1] > self._stream_capacity:
+            raise ValueError(
+                f"KV cache overflow: stream position {self._stream_pos} + "
+                f"{x.shape[1]} new tokens > max_cache "
+                f"{self._stream_capacity}; raise SelfAttentionLayer."
+                "max_cache or rnn_clear_previous_state()")
+        self._stream_pos += x.shape[1]
         carry = self._rnn_state or {}
-        out, _, new_carry, _ = self._forward(self.params, self.state, x, None,
-                                             train=False, rng=None, carry=carry)
+        # jitted per (shape, carry structure) — see ComputationGraph
+        # .rnn_time_step: eager per-op dispatch dominates streaming cost
+        key = ("rnn_stream", x.shape, jax.tree_util.tree_structure(carry))
+        if key not in self._output_cache:
+            def fwd(params, state, x, carry):
+                out, _, new_carry, _ = self._forward(
+                    params, state, x, None, train=False, rng=None,
+                    carry=carry)
+                return out, new_carry
+            self._output_cache[key] = jax.jit(fwd)
+        out, new_carry = self._output_cache[key](self.params, self.state,
+                                                 x, carry)
         self._rnn_state = new_carry
         return out[:, 0] if squeeze and out.ndim == 3 else out
+
+    def _seed_streaming_carry(self, batch: int) -> dict:
+        """Initial streaming carry + resets static overflow accounting."""
+        dtype = jnp.dtype(self.conf.dtype)
+        seed = {}
+        caps = []
+        for i, layer in enumerate(self.layers):
+            c = layer.init_streaming_carry(batch, dtype)
+            if c:
+                seed[str(i)] = c
+                if hasattr(layer, "max_cache"):
+                    caps.append(layer.max_cache)
+        self._stream_pos = 0
+        self._stream_capacity = min(caps) if caps else None
+        return seed
 
     # ---------------------------------------------------------- pretraining
     def pretrain(self, data_iterator, epochs: int = 1):
