@@ -11,7 +11,10 @@ and an explicit end-of-stream marker.
 Wire protocol (all big-endian):
     frame   = op(1) topic_len(2) topic payload_len(4) payload
     ops     : P publish data | E end-of-topic | S subscribe (payload "")
-Subscribers receive the publisher's P/E frames verbatim for their topic.
+            | K subscribe-ack (broker -> subscriber, payload "")
+A subscriber sends S and MUST read the K ack before treating the
+connection as live; after the ack it receives the publisher's P/E frames
+verbatim for its topic, with no frame published after the ack missed.
 
 Run standalone: ``python -m deeplearning4j_tpu.streaming.broker --port N``
 or embedded: ``StreamingBroker(port=0).start()``.
@@ -31,6 +34,7 @@ _LEN = struct.Struct(">I")
 OP_PUBLISH = b"P"
 OP_END = b"E"
 OP_SUBSCRIBE = b"S"
+OP_SUB_ACK = b"K"
 
 MAX_FRAME_BYTES = 1 << 30  # defensive bound on payload_len
 
@@ -169,6 +173,12 @@ class StreamingBroker:
 
     def _add_subscriber(self, conn: socket.socket, topic: str):
         sub = _Subscriber(conn, topic, self.subscriber_buffer)
+        # the ack is queued BEFORE registration (the queue is private
+        # until the sub is in _subs), so it is guaranteed to be frame #1:
+        # once the consumer has read it, the subscription is registered
+        # and no subsequently published frame can be missed — and no
+        # racing publish can slip a data frame ahead of the ack
+        sub.q.put((OP_SUB_ACK, b""))
         with self._lock:
             self._subs.setdefault(topic, []).append(sub)
         t = threading.Thread(target=self._writer, args=(sub,), daemon=True)

@@ -19,6 +19,7 @@ from deeplearning4j_tpu.datasets.streaming import QueueDataSetIterator
 from deeplearning4j_tpu.streaming.broker import (
     OP_END,
     OP_PUBLISH,
+    OP_SUB_ACK,
     OP_SUBSCRIBE,
     read_frame,
     write_frame,
@@ -75,11 +76,24 @@ class NDArrayConsumer:
         self.topic = topic
         self._sock = socket.create_connection((host, port),
                                               timeout=connect_timeout)
-        # CONNECT timeout only: a producer idling minutes between
-        # publishes is normal for a live training feed; a recv timeout
-        # here would surface as a silent early end-of-stream to fit()
+        try:
+            # the handshake stays under connect_timeout (a wedged broker
+            # must not hang construction forever)
+            write_frame(self._sock, OP_SUBSCRIBE, topic)
+            # wait for the broker's registration ack: after this, no
+            # frame published to the topic can be missed
+            frame = read_frame(self._sock)
+            if frame is None or frame[0] != OP_SUB_ACK:
+                raise ConnectionError(
+                    f"broker did not acknowledge subscription to "
+                    f"'{topic}'")
+        except BaseException:
+            self._sock.close()  # no object escapes: close or leak the fd
+            raise
+        # from here on, block indefinitely: a producer idling minutes
+        # between publishes is normal for a live training feed; a recv
+        # timeout would surface as a silent early end-of-stream to fit()
         self._sock.settimeout(None)
-        write_frame(self._sock, OP_SUBSCRIBE, topic)
 
     def __iter__(self) -> Iterator[DataSet]:
         while True:

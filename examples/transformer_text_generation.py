@@ -1,0 +1,64 @@
+"""Char-level language modeling with the TransformerLM — the
+dl4j-examples GravesLSTMCharModellingExample flow, transformer edition:
+train on a small corpus, then generate text with KV-cache streaming
+decode (one compiled device-side loop; see models/zoo.greedy_generate).
+
+Run: python examples/transformer_text_generation.py
+Env: EXAMPLES_SMOKE=1 shrinks sizes for the test-suite smoke run.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SMOKE = bool(os.environ.get("EXAMPLES_SMOKE"))
+if SMOKE:  # the smoke run must be hermetic: never touch a real device
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.models import TransformerLM, greedy_generate
+
+# a tiny synthetic "language": one repeated sentence, so a small model
+# can memorize real character-level structure
+SENTENCE = "the quick brown fox jumps over the lazy dog and runs "
+
+
+def main():
+    text = SENTENCE * (20 if SMOKE else 400)
+    chars = sorted(set(text))
+    V = len(chars)
+    c2i = {c: i for i, c in enumerate(chars)}
+    ids = np.asarray([c2i[c] for c in text], np.int64)
+
+    T = 32 if SMOKE else 64
+    n_seq = 32 if SMOKE else 256
+    rs = np.random.RandomState(0)
+    starts = rs.randint(0, len(ids) - T - 1, n_seq)
+    seq = np.stack([ids[s:s + T + 1] for s in starts])
+    eye = np.eye(V, dtype=np.float32)
+    ds = DataSet(eye[seq[:, :-1]], eye[seq[:, 1:]])
+
+    m = TransformerLM(num_labels=V, max_length=T, d_model=128, n_heads=4,
+                      n_blocks=2, seed=7).init()
+    for _ in range(8 if SMOKE else 600):
+        m.fit(ds)
+    print(f"trained; final score {m.score_value:.4f}")
+
+    prompt_text = "the quick "
+    prompt = np.asarray([[c2i[c] for c in prompt_text]] * 1, np.int64)
+    gen = greedy_generate(m, prompt, steps=24, vocab=V,
+                          device_loop=not SMOKE)
+    out = "".join(chars[i] for i in gen[0])
+    print(f"prompt {prompt_text!r} -> generated {out!r}")
+    print(f"TRAINED iterations: {m.iteration}")
+    if not SMOKE:
+        # the model must continue the memorized sentence structure
+        assert out.startswith("brown fox jumps"), out
+
+
+if __name__ == "__main__":
+    main()
