@@ -14,11 +14,12 @@ from deeplearning4j_tpu.models.zoo import (
     VGG19,
     ZooModel,
     greedy_generate,
+    sample_generate,
     zoo_models,
 )
 
 __all__ = [
     "AlexNet", "FaceNetNN4Small2", "GoogLeNet", "InceptionResNetV1", "LeNet",
     "ResNet50", "SimpleCNN", "TextGenerationLSTM", "TransformerLM", "VGG16", "VGG19",
-    "ZooModel", "greedy_generate", "zoo_models",
+    "ZooModel", "greedy_generate", "sample_generate", "zoo_models",
 ]

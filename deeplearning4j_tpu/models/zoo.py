@@ -830,19 +830,35 @@ class TransformerLM(ZooModel):
 
 def greedy_generate(net, prompt_ids, steps: int, vocab: int,
                     device_loop: bool = True):
-    """Greedy autoregressive decoding via KV-cache streaming: the prompt
-    is consumed once, then each new token costs ONE incremental
-    attention row (cached keys/values — O(T) per token) instead of a
-    full O(T^2) re-forward. Works with any one-hot-input causal LM
-    (TransformerLM; TextGenerationLSTM streams through its h/c the same
-    way).
+    """Greedy decoding — ``sample_generate`` with temperature 0 (see
+    there for the KV-cache / device-loop mechanics)."""
+    return sample_generate(net, prompt_ids, steps, vocab,
+                           temperature=0.0, device_loop=device_loop)
+
+
+def sample_generate(net, prompt_ids, steps: int, vocab: int,
+                    temperature: float = 1.0, top_k: int = 0,
+                    seed: int = 0, device_loop: bool = True):
+    """Autoregressive decoding via KV-cache streaming: the prompt is
+    consumed once, then each new token costs ONE incremental attention
+    row (cached keys/values — O(T) per token) instead of a full O(T^2)
+    re-forward. Works with any one-hot-input causal LM (TransformerLM;
+    TextGenerationLSTM streams through its h/c the same way).
+
+    ``temperature``: 0 = greedy argmax; otherwise tokens are sampled
+    from softmax probabilities sharpened by 1/temperature (the
+    char-modelling example's sampleFromDistribution semantics).
+    ``top_k``: when > 0, restrict sampling to the k most likely tokens.
 
     ``device_loop=True`` (default) compiles the WHOLE decode as one XLA
-    program — a ``lax.scan`` whose body is forward + argmax + one-hot
-    feedback — so the host pays a single dispatch instead of one
-    round-trip per token (measured ~115 ms/token of pure tunnel latency
-    on the CI chip). ``device_loop=False`` streams through
-    ``rnn_time_step`` one token at a time (same math, host-driven).
+    program — a ``lax.scan`` whose body is forward + next-token select +
+    one-hot feedback (sampling uses jax.random.categorical with a
+    per-step folded key) — so the host pays a single dispatch instead of
+    one round-trip per token (measured ~115 ms/token of pure tunnel
+    latency on the CI chip). ``device_loop=False`` streams through
+    ``rnn_time_step`` one token at a time (same math, host-driven;
+    sampling then uses numpy's RNG, so the two paths agree exactly only
+    at temperature 0).
 
     prompt_ids: [B, T0] int array. Returns [B, steps] generated ids.
     """
@@ -850,23 +866,42 @@ def greedy_generate(net, prompt_ids, steps: int, vocab: int,
 
     if steps < 1:
         raise ValueError(f"steps must be >= 1, got {steps}")
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if top_k < 0 or top_k > vocab:
+        raise ValueError(f"top_k must be in [0, vocab], got {top_k}")
     prompt_ids = np_.asarray(prompt_ids)
     if device_loop:
-        return np_.asarray(_device_greedy_generate(net, prompt_ids, steps,
-                                                   vocab))
+        return np_.asarray(_device_generate(net, prompt_ids, steps, vocab,
+                                            temperature, top_k, seed))
+
+    rs = np_.random.RandomState(seed)
+
+    def pick(probs):  # [B, V] -> [B]
+        if temperature <= 0:
+            return probs.argmax(-1)
+        logp = np_.log(np_.maximum(probs, 1e-30)) / temperature
+        if top_k > 0:
+            kth = np_.sort(logp, axis=-1)[:, -top_k][:, None]
+            logp = np_.where(logp >= kth, logp, -1e30)
+        p = np_.exp(logp - logp.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        return np_.stack([rs.choice(vocab, p=row) for row in p])
+
     eye = np_.eye(vocab, dtype=np_.float32)
     net.rnn_clear_previous_state()
     out = net.rnn_time_step(eye[prompt_ids])          # [B, T0, V]
-    last = np_.asarray(out)[:, -1].argmax(-1)         # [B]
+    last = pick(np_.asarray(out)[:, -1])              # [B]
     generated = [last]
     for _ in range(steps - 1):
         out = net.rnn_time_step(eye[last][:, None, :])  # [B, 1, V]
-        last = np_.asarray(out)[:, 0].argmax(-1)
+        last = pick(np_.asarray(out)[:, 0])
         generated.append(last)
     return np_.stack(generated, axis=1)
 
 
-def _device_greedy_generate(net, prompt_ids, steps: int, vocab: int):
+def _device_generate(net, prompt_ids, steps: int, vocab: int,
+                     temperature: float, top_k: int, seed: int):
     """One jitted program: consume the prompt, then lax.scan the
     token-by-token decode on device (KV caches ride in the scan carry)."""
     import jax
@@ -887,10 +922,11 @@ def _device_greedy_generate(net, prompt_ids, steps: int, vocab: int):
             f"KV cache overflow: prompt + generated positions ({needed}) "
             f"> max_cache ({cap}); raise SelfAttentionLayer.max_cache")
 
-    # one compiled program per (shapes, steps): cached on the net like
-    # rnn_time_step's step fn — a serving loop must not re-trace the
-    # whole scan program per request
-    key = ("greedy_generate", B, prompt_ids.shape[1], steps, vocab)
+    # one compiled program per (shapes, steps, sampling config): cached
+    # on the net like rnn_time_step's step fn — a serving loop must not
+    # re-trace the whole scan program per request
+    key = ("generate", B, prompt_ids.shape[1], steps, vocab,
+           float(temperature), int(top_k))
     if key not in net._output_cache:
         def fwd(params, state, x, carry):
             if is_graph:
@@ -903,22 +939,31 @@ def _device_greedy_generate(net, prompt_ids, steps: int, vocab: int):
                                                 carry=carry)
             return out, new_carry
 
-        def generate(params, state, prompt_onehot, carry):
+        def pick(probs, k):  # [B, V], key -> [B]
+            if temperature <= 0:
+                return jnp.argmax(probs, axis=-1)
+            logits = jnp.log(jnp.maximum(probs, 1e-30)) / temperature
+            if top_k > 0:
+                kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+                logits = jnp.where(logits >= kth, logits, -1e30)
+            return jax.random.categorical(k, logits)
+
+        def generate(params, state, prompt_onehot, carry, rng):
             out, carry = fwd(params, state, prompt_onehot, carry)
-            last = jnp.argmax(out[:, -1], axis=-1)
+            last = pick(out[:, -1], jax.random.fold_in(rng, 0))
             if steps == 1:
                 return last[:, None]
 
-            def body(c, _):
+            def body(c, i):
                 carry, last = c
                 x = jax.nn.one_hot(last, vocab,
                                    dtype=prompt_onehot.dtype)[:, None, :]
                 o, carry = fwd(params, state, x, carry)
-                nxt = jnp.argmax(o[:, 0], axis=-1)
+                nxt = pick(o[:, 0], jax.random.fold_in(rng, i))
                 return (carry, nxt), nxt
 
-            (_, _), rest = jax.lax.scan(body, (carry, last), None,
-                                        length=steps - 1)
+            (_, _), rest = jax.lax.scan(body, (carry, last),
+                                        jnp.arange(1, steps))
             return jnp.concatenate([last[:, None],
                                     jnp.moveaxis(rest, 0, 1)], axis=1)
 
@@ -926,7 +971,7 @@ def _device_greedy_generate(net, prompt_ids, steps: int, vocab: int):
 
     eye = jnp.eye(vocab, dtype=jnp.dtype(net.conf.dtype))
     out = net._output_cache[key](net.params, net.state, eye[prompt_ids],
-                                 carry0)
+                                 carry0, jax.random.PRNGKey(seed))
     # the generation stream's carry lived only inside the program;
     # leave the net with no half-open stream
     net.rnn_clear_previous_state()

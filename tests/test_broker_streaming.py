@@ -160,3 +160,24 @@ class TestCrossProcess:
             assert np.isfinite(net.score_value)
         finally:
             broker.stop()
+
+
+class TestLargeFrames:
+    def test_multi_megabyte_batch_roundtrip(self):
+        """Image-sized batches (a ~12 MB frame) survive framing and npz
+        serde intact — length-prefixed frames, not line-based."""
+        rs = np.random.RandomState(0)
+        big = rs.randn(16, 224, 224, 3).astype(np.float32)  # ~9.6 MB
+        labels = np.eye(10, dtype=np.float32)[rs.randint(0, 10, 16)]
+        broker = StreamingBroker(port=0).start()
+        try:
+            with NDArrayConsumer("127.0.0.1", broker.port, "img") as c, \
+                    NDArrayPublisher("127.0.0.1", broker.port, "img") as p:
+                p.publish(DataSet(big, labels))
+                p.end()
+                got = list(c)
+            assert len(got) == 1
+            np.testing.assert_array_equal(got[0].features, big)
+            np.testing.assert_array_equal(got[0].labels, labels)
+        finally:
+            broker.stop()

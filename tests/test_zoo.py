@@ -223,3 +223,38 @@ def test_streaming_cache_overflow_raises():
     m.rnn_time_step(x)                 # 3 of 4 slots used
     with pytest.raises(ValueError, match="KV cache overflow"):
         m.rnn_time_step(x)             # 3 more would exceed 4
+
+
+@pytest.mark.parametrize("device_loop", [True, False])
+def test_sample_generate_temperature_and_topk(device_loop):
+    """temperature=0 == greedy; sampled tokens vary with seed but stay
+    inside the top-k support set — both the device lax.scan path and the
+    host-driven rnn_time_step path."""
+    from deeplearning4j_tpu.models import greedy_generate, sample_generate
+
+    V, T = 13, 12
+    m = TransformerLM(num_labels=V, max_length=T, d_model=16, n_heads=2,
+                      n_blocks=1, seed=6).init()
+    rs = np.random.RandomState(1)
+    prompt = rs.randint(0, V, (2, 4))
+    kw = dict(vocab=V, device_loop=device_loop)
+
+    g = greedy_generate(m, prompt, steps=6, vocab=V)
+    s0 = sample_generate(m, prompt, steps=6, temperature=0.0, **kw)
+    np.testing.assert_array_equal(g, s0)  # temp 0 IS greedy
+
+    a = sample_generate(m, prompt, steps=6, temperature=1.5, seed=1, **kw)
+    b = sample_generate(m, prompt, steps=6, temperature=1.5, seed=2, **kw)
+    c = sample_generate(m, prompt, steps=6, temperature=1.5, seed=1, **kw)
+    np.testing.assert_array_equal(a, c)   # deterministic in seed
+    assert (a != b).any()                 # varies across seeds
+
+    # top_k=1 is greedy regardless of temperature
+    k1 = sample_generate(m, prompt, steps=6, temperature=2.0, top_k=1,
+                         seed=3, **kw)
+    np.testing.assert_array_equal(k1, g)
+
+    with pytest.raises(ValueError, match="top_k"):
+        sample_generate(m, prompt, steps=2, top_k=V + 1, **kw)
+    with pytest.raises(ValueError, match="temperature"):
+        sample_generate(m, prompt, steps=2, temperature=-0.5, **kw)
