@@ -925,8 +925,11 @@ def _device_generate(net, prompt_ids, steps: int, vocab: int,
     # one compiled program per (shapes, steps, sampling config): cached
     # on the net like rnn_time_step's step fn — a serving loop must not
     # re-trace the whole scan program per request
+    # at temperature 0 the traced pick() is a pure argmax that ignores
+    # top_k: normalize it out of the key so greedy programs are not
+    # recompiled once per distinct (ignored) top_k value
     key = ("generate", B, prompt_ids.shape[1], steps, vocab,
-           float(temperature), int(top_k))
+           float(temperature), int(top_k) if temperature > 0 else 0)
     if key not in net._output_cache:
         def fwd(params, state, x, carry):
             if is_graph:

@@ -53,3 +53,29 @@ class TestWordVectorFormat:
         i = words.index("w1")
         np.testing.assert_allclose(vecs[i], exp["w1"], atol=1e-6)
         assert vecs.shape[1] == 12
+
+    def test_round5_transformer_zip_loads_and_reproduces(self):
+        """Round-5 fixture: a trained TransformerLM ComputationGraph —
+        pins the wire format of the graph config plus the new layer
+        types (SelfAttentionLayer, LayerNormalization,
+        PositionalEncodingLayer) and their params."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.utils.model_serializer import load_model
+
+        net = load_model(os.path.join(
+            FIXTURES, "regression_transformer_r5.zip"))
+        exp = np.load(os.path.join(
+            FIXTURES, "regression_transformer_r5_expected.npz"))
+        assert abs(float(np.asarray(net.params_flat()).sum())
+                   - float(exp["params_sum"])) < 1e-4
+        out = np.asarray(net.output(exp["probe"]))
+        np.testing.assert_allclose(out, exp["output"], atol=1e-5)
+        # loaded graph remains trainable AND streamable
+        V = exp["probe"].shape[-1]
+        rs = np.random.RandomState(1)
+        idx = rs.randint(0, V, (2, exp["probe"].shape[1]))
+        oh = np.eye(V, dtype=np.float32)[idx]
+        net.fit(DataSet(oh, oh))
+        net.rnn_clear_previous_state()
+        stream = np.asarray(net.rnn_time_step(exp["probe"][:, :3]))
+        assert stream.shape == (2, 3, V)

@@ -149,3 +149,16 @@ class TestValidationBypassesClosed:
         b.set_outputs("out")
         with pytest.raises(ValueError, match="n_out must be > 0"):
             b.build()
+
+
+def test_attention_heads_must_divide_width():
+    from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import (RnnOutputLayer,
+                                                   SelfAttentionLayer)
+
+    with pytest.raises(ValueError, match="divisible"):
+        (NeuralNetConfiguration.builder().seed(1)
+         .list(SelfAttentionLayer(n_out=10, n_heads=3),
+               RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+         .set_input_type(InputType.recurrent(10, 8)).build())
