@@ -776,12 +776,18 @@ class TransformerLM(ZooModel):
 
     def __init__(self, num_labels: int = 256, max_length: int = 128,
                  d_model: int = 256, n_heads: int = 8, n_blocks: int = 4,
-                 **kw):
+                 remat: bool = False, **kw):
         super().__init__(num_labels=num_labels, **kw)
         self.max_length = max_length
         self.d_model = d_model
         self.n_heads = n_heads
         self.n_blocks = n_blocks
+        # jax.checkpoint the attention / FFN-expansion vertices: backward
+        # recomputes their internal activations at the cost of one extra
+        # forward. Per-vertex boundaries mean boundary outputs are still
+        # stored as residuals (see LayerVertex.remat) — the saving is the
+        # inside-vertex intermediates, not whole-block memory.
+        self.remat = remat
         self.input_shape = (max_length, num_labels)
 
     def conf(self):
@@ -803,13 +809,13 @@ class TransformerLM(ZooModel):
             g.add_layer(f"attn{i}",
                         SelfAttentionLayer(n_out=D, n_heads=self.n_heads,
                                            causal=True, helper="auto"),
-                        f"ln{i}a")
+                        f"ln{i}a", remat=self.remat)
             g.add_vertex(f"res{i}a", ElementWiseVertex(op="add"),
                          x, f"attn{i}")
             g.add_layer(f"ln{i}b", LayerNormalization(), f"res{i}a")
             g.add_layer(f"ff{i}a", DenseLayer(n_out=4 * D,
                                               activation="gelu"),
-                        f"ln{i}b")
+                        f"ln{i}b", remat=self.remat)
             g.add_layer(f"ff{i}b", DenseLayer(n_out=D,
                                               activation="identity"),
                         f"ff{i}a")

@@ -107,6 +107,14 @@ class LayerVertex(GraphVertex):
 
     layer: Optional[Layer] = None
     preprocessor: Optional[InputPreProcessor] = None
+    # rematerialization (jax.checkpoint): when True, this vertex's
+    # INTERNAL activations are recomputed in the backward pass instead of
+    # stored — trading MXU FLOPs for HBM. Per-vertex boundary: the
+    # vertex's OUTPUT is still a residual for downstream consumers, so
+    # the win is the intermediates inside the vertex (attention scores /
+    # pre-activations), not whole-block activation memory. Training-path
+    # only; inference/streaming never stashes.
+    remat: bool = False
 
     def finalize(self, g=None) -> None:
         self.layer.finalize(g)
@@ -139,6 +147,14 @@ class LayerVertex(GraphVertex):
         if self.preprocessor is not None:
             x = self.preprocessor.forward(x, rng=preprocessor_key(rng))
             mask = self.preprocessor.feed_forward_mask(mask)
+        if self.remat and train:
+            import jax as _jax
+
+            def run(p, xx, st, mk, k):
+                return self.layer.forward(p, st, xx, mask=mk, train=True,
+                                          rng=k)
+
+            return _jax.checkpoint(run)(params, x, state, mask, rng)
         return self.layer.forward(params, state, x, mask=mask, train=train,
                                   rng=rng)
 
@@ -517,10 +533,11 @@ class GraphBuilder:
         return self
 
     def add_layer(self, name: str, layer: Layer, *inputs,
-                  preprocessor: Optional[InputPreProcessor] = None
-                  ) -> "GraphBuilder":
+                  preprocessor: Optional[InputPreProcessor] = None,
+                  remat: bool = False) -> "GraphBuilder":
         return self.add_vertex(
-            name, LayerVertex(layer=layer, preprocessor=preprocessor), *inputs)
+            name, LayerVertex(layer=layer, preprocessor=preprocessor,
+                              remat=remat), *inputs)
 
     def add_vertex(self, name: str, vertex: GraphVertex, *inputs
                    ) -> "GraphBuilder":

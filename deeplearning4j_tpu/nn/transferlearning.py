@@ -288,9 +288,11 @@ class TransferLearning:
                                           for o in self._conf.network_outputs]
             return self
 
-        def add_layer(self, name: str, layer, *inputs, preprocessor=None):
+        def add_layer(self, name: str, layer, *inputs, preprocessor=None,
+                      remat: bool = False):
             return self.add_vertex(
-                name, LayerVertex(layer=layer, preprocessor=preprocessor),
+                name, LayerVertex(layer=layer, preprocessor=preprocessor,
+                                  remat=remat),
                 *inputs)
 
         def add_vertex(self, name: str, vertex: GraphVertex, *inputs):

@@ -3,9 +3,10 @@ regressiontest/RegressionTest050/060/071/080.java — model files produced
 by OLD versions must keep loading and producing identical outputs; the
 serialization format is a tested contract, not an implementation detail).
 
-The fixtures under tests/fixtures/ were produced by the round-4 code and
-are COMMITTED — never regenerate them to make a failing test pass; a
-failure here means the format or numerics changed incompatibly.
+The fixtures under tests/fixtures/ are COMMITTED artifacts of the round
+that produced them (``*_r4`` by round-4 code, ``*_r5`` by round-5 code) —
+never regenerate them to make a failing test pass; a failure here means
+the format or numerics changed incompatibly.
 """
 
 import os
