@@ -247,7 +247,11 @@ def _inbound_names(layer: dict):
 
 def _is_linear(layers) -> bool:
     """True when every non-input layer has exactly one distinct input and
-    nothing branches (each producer feeds at most one consumer)."""
+    nothing branches (each producer feeds at most one consumer). A model
+    with several InputLayers is never linear — flattening disjoint input
+    chains into one stack would mis-wire them."""
+    if sum(1 for l in layers if l["class_name"] == "InputLayer") > 1:
+        return False
     consumers: dict = {}
     for l in layers:
         if l["class_name"] == "InputLayer":

@@ -383,3 +383,32 @@ class TestFunctionalBranchedImport:
             f.attrs["model_config"] = json.dumps(config)
         with pytest.raises(ValueError, match="shared"):
             import_keras_model_and_weights(path)
+
+    def test_two_input_disjoint_chains_not_flattened(self, keras, tmp_path):
+        """Two inputs with fully DISJOINT chains to two outputs — every
+        layer is single-input and nothing fans out, so only the
+        multi-InputLayer guard keeps this off the sequential path, which
+        would silently mis-wire the chains into one stack."""
+        from keras import layers
+
+        from deeplearning4j_tpu.modelimport import \
+            import_keras_model_and_weights
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        in_a = keras.Input((4,), name="ia")
+        in_b = keras.Input((6,), name="ib")
+        oa = layers.Dense(3, activation="softmax", name="oa")(in_a)
+        ob = layers.Dense(2, activation="softmax", name="ob")(in_b)
+        m = keras.Model([in_a, in_b], [oa, ob])
+        path = str(tmp_path / "disjoint.h5")
+        m.save(path)
+        net = import_keras_model_and_weights(path)
+        assert isinstance(net, ComputationGraph)
+        rs = np.random.RandomState(3)
+        xa = rs.randn(3, 4).astype(np.float32)
+        xb = rs.randn(3, 6).astype(np.float32)
+        got = net.output(xa, xb)
+        exp = m.predict([xa, xb], verbose=0)
+        for g, e in zip(got, exp):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(e),
+                                       atol=1e-4, rtol=1e-3)
