@@ -211,7 +211,7 @@ def bench_vgg16(batch: int = VGG16_BATCH, steps: int = 10,
     return _median_of_windows(timer, k_windows)
 
 
-def bench_lenet(batch: int = 512, steps: int = 40, k_windows: int = 5):
+def bench_lenet(batch: int = 512, steps: int = 80, k_windows: int = 5):
     """LeNet-MNIST training throughput (median, windows) (BASELINE #1)."""
     from deeplearning4j_tpu.models import LeNet
 
@@ -223,7 +223,7 @@ def bench_lenet(batch: int = 512, steps: int = 40, k_windows: int = 5):
 
 
 def bench_lstm(batch: int = 64, seq: int = 50, vocab: int = 77,
-               steps: int = 20, k_windows: int = 5):
+               steps: int = 60, k_windows: int = 5):
     """GravesLSTM char-RNN training throughput (median tokens/s, windows)
     (BASELINE config #3)."""
     from deeplearning4j_tpu.models import TextGenerationLSTM

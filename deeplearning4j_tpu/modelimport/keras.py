@@ -200,12 +200,17 @@ def import_keras_model_and_weights(path: str):
         config = _model_config(f)
     if config["class_name"] == "Sequential":
         return import_keras_sequential_model_and_weights(path)
-    layers = config["config"]["layers"] \
-        if isinstance(config["config"], dict) else config["config"]
-    if _is_linear(layers):
-        # linear chains keep the (simpler, flat-indexed) sequential path;
-        # the InputLayer stays in the list — it contributes no layer but
-        # carries the input shape (Keras 3 puts batch_shape only there)
+    cfg = config["config"]
+    layers = cfg["layers"] if isinstance(cfg, dict) else cfg
+    n_outputs = (len(_layer_refs(cfg.get("output_layers", [])))
+                 if isinstance(cfg, dict) else 1)
+    if n_outputs <= 1 and _is_linear(layers):
+        # single-output linear chains keep the (simpler, flat-indexed)
+        # sequential path; a multi-OUTPUT model must stay functional even
+        # when its layer chain looks linear, or intermediate outputs are
+        # silently dropped. The InputLayer stays in the list — it
+        # contributes no layer but carries the input shape (Keras 3 puts
+        # batch_shape only there)
         fake = {"class_name": "Sequential", "config": list(layers)}
         return _import_sequential(path, fake)
     return _import_functional(path, config)
@@ -243,6 +248,19 @@ def _inbound_names(layer: dict):
         for ref in node:
             names.append(ref[0] if isinstance(ref, (list, tuple)) else ref)
     return names
+
+
+def _layer_refs(v):
+    """Normalize input_layers/output_layers config entries to layer
+    names: ['name', 0, 0] | [['a',0,0], ['b',0,0]] | ['a', 'b']."""
+    if not isinstance(v, (list, tuple)):
+        return [v]
+    if v and not isinstance(v[0], (list, tuple)):
+        # either a single ['name', n, t] triple or a list of names
+        if len(v) >= 2 and isinstance(v[1], int):
+            return [v[0]]
+        return list(v)
+    return [r[0] if isinstance(r, (list, tuple)) else r for r in v]
 
 
 def _is_linear(layers) -> bool:
@@ -354,18 +372,8 @@ def _import_functional(path: str, config: dict):
                             "channels_last": "tf"}.get(d, d)
             break
 
-    def _refs(v):  # ['name', 0, 0] | [['a',0,0], ['b',0,0]] | ['a', 'b']
-        if not isinstance(v, (list, tuple)):
-            return [v]
-        if v and not isinstance(v[0], (list, tuple)):
-            # either a single ['name', n, t] triple or a list of names
-            if len(v) >= 2 and isinstance(v[1], int):
-                return [v[0]]
-            return list(v)
-        return [r[0] if isinstance(r, (list, tuple)) else r for r in v]
-
-    output_names = _refs(cfg.get("output_layers", []))
-    input_names = _refs(cfg.get("input_layers", []))
+    output_names = _layer_refs(cfg.get("output_layers", []))
+    input_names = _layer_refs(cfg.get("input_layers", []))
 
     builder = (NeuralNetConfiguration.builder().seed(12345).graph_builder())
     alias: dict = {}       # dropped layer name -> upstream effective name

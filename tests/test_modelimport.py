@@ -409,6 +409,35 @@ class TestFunctionalBranchedImport:
         xb = rs.randn(3, 6).astype(np.float32)
         got = net.output(xa, xb)
         exp = m.predict([xa, xb], verbose=0)
+        assert len(got) == len(exp) == 2
+        for g, e in zip(got, exp):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(e),
+                                       atol=1e-4, rtol=1e-3)
+
+
+    def test_single_input_multi_output_stays_functional(self, keras,
+                                                        tmp_path):
+        """One input, TWO outputs on a linear chain: must import as a
+        two-output ComputationGraph, not a flattened stack that silently
+        drops the intermediate output."""
+        from keras import layers
+
+        from deeplearning4j_tpu.modelimport import \
+            import_keras_model_and_weights
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        inp = keras.Input((5,), name="in0")
+        mid = layers.Dense(4, activation="softmax", name="mid")(inp)
+        fin = layers.Dense(2, activation="softmax", name="fin")(mid)
+        m = keras.Model(inp, [mid, fin])
+        path = str(tmp_path / "multiout.h5")
+        m.save(path)
+        net = import_keras_model_and_weights(path)
+        assert isinstance(net, ComputationGraph)
+        x = np.random.RandomState(4).randn(3, 5).astype(np.float32)
+        got = net.output(x)
+        exp = m.predict(x, verbose=0)
+        assert len(got) == len(exp) == 2
         for g, e in zip(got, exp):
             np.testing.assert_allclose(np.asarray(g), np.asarray(e),
                                        atol=1e-4, rtol=1e-3)
