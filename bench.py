@@ -377,9 +377,10 @@ def bench_word2vec(n_sentences: int = 50000, epochs: int = 1):
 
 
 def bench_doc2vec(n_docs: int = 4000, epochs: int = 1):
-    """DBOW words/s, streamed device-resident epochs (reference:
-    dl4j-examples ParagraphVectors workloads; round-3 trained one dispatch
-    per document)."""
+    """DBOW words/s (reference: dl4j-examples ParagraphVectors workloads).
+    Measures both backends like bench_word2vec: 'auto' (native DBOW pair
+    kernel for this config, the DBOW.java analog) is the headline; the
+    device path rides along."""
     from deeplearning4j_tpu.nlp import ParagraphVectors
     from deeplearning4j_tpu.nlp.tokenization import LabelledDocument
 
@@ -389,19 +390,27 @@ def bench_doc2vec(n_docs: int = 4000, epochs: int = 1):
     docs = [LabelledDocument(
         " ".join(vocab[z] for z in zipf[i * 40:(i + 1) * 40]), f"doc_{i}")
         for i in range(n_docs)]
-    pv = ParagraphVectors(layer_size=100, window=5, min_word_frequency=2,
-                          negative=5, use_hierarchic_softmax=False,
-                          epochs=epochs, sequence_algorithm="dbow", seed=11)
-    pv.build_vocab_from_documents(docs)
-    pv.reset_weights()
     total_words = n_docs * 40 * epochs
-    pv.fit(docs)          # warmup: compiles the epoch program
-    pv.syn0 = None
-    pv.reset_weights()
-    t0 = time.perf_counter()
-    pv.fit(docs)
-    _sync(pv.syn0)
-    return total_words / (time.perf_counter() - t0)
+    out = {}
+    for key, backend in (("doc2vec_words_s", "auto"),
+                         ("doc2vec_device_words_s", "device")):
+        pv = ParagraphVectors(layer_size=100, window=5,
+                              min_word_frequency=2, negative=5,
+                              use_hierarchic_softmax=False, epochs=epochs,
+                              sequence_algorithm="dbow", seed=11,
+                              backend=backend)
+        pv.build_vocab_from_documents(docs)
+        pv.reset_weights()
+        pv.fit(docs)          # warmup: compiles the epoch program
+        pv.syn0 = None
+        pv.reset_weights()
+        t0 = time.perf_counter()
+        pv.fit(docs)
+        if not isinstance(pv.syn0, np.ndarray):
+            _sync(pv.syn0)    # device path only; native is synchronous
+        out[key] = _sane("doc2vec_words_s",
+                         total_words / (time.perf_counter() - t0))
+    return out
 
 
 # Physically-possible ceilings per metric (an order of magnitude above any

@@ -182,3 +182,54 @@ def parse_numeric_csv(path: str, delimiter: str = ",",
     if rc != 0:
         return None
     return out.reshape(rows.value, cols.value)
+
+
+NATIVE_MAX_LAYER = 4096  # fixed accumulator size in skipgram.c
+
+
+def _bind_pairs(lib):
+    """Bind pairs_train, or None when the loaded .so predates it (stale
+    artifact with equal mtime): native stays a soft dependency."""
+    if not hasattr(lib, "pairs_train"):
+        return None
+    if not hasattr(lib, "_pairs_bound"):
+        lib.pairs_train.restype = ctypes.c_long
+        lib.pairs_train.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.c_int), ctypes.c_long,
+            ctypes.c_int, ctypes.c_float, ctypes.c_float, ctypes.c_int,
+            ctypes.c_ulonglong]
+        lib._pairs_bound = True
+    return lib
+
+
+def ns_pairs_train(syn0, syn1neg, rows, targets, table, *, negative: int,
+                   alpha: float, min_alpha: float, epochs: int = 1,
+                   seed: int = 1):
+    """In-place native negative-sampling pair training: rows[i] (syn0
+    input row) predicts targets[i] (syn1neg output row) — the DBOW hot
+    loop (sequence/DBOW.java) and any other pre-generated pair stream.
+    Returns trained pair count + updated arrays, or None when the native
+    library is unavailable."""
+    lib = _load_skipgram()
+    if lib is None or _bind_pairs(lib) is None:
+        return None
+    syn0 = np.ascontiguousarray(syn0, np.float32)
+    syn1neg = np.ascontiguousarray(syn1neg, np.float32)
+    rows = np.ascontiguousarray(rows, np.int32)
+    targets = np.ascontiguousarray(targets, np.int32)
+    table = np.ascontiguousarray(table, np.int32)
+    fp = ctypes.POINTER(ctypes.c_float)
+    ip = ctypes.POINTER(ctypes.c_int)
+    n = lib.pairs_train(
+        syn0.ctypes.data_as(fp), syn1neg.ctypes.data_as(fp),
+        syn0.shape[1],
+        rows.ctypes.data_as(ip), targets.ctypes.data_as(ip), len(rows),
+        table.ctypes.data_as(ip), len(table),
+        negative, alpha, min_alpha, epochs, seed)
+    if n < 0:
+        return None
+    return n, syn0, syn1neg

@@ -121,3 +121,57 @@ long skipgram_train(float *syn0, float *syn1neg, long vocab, long layer,
     }
     return pairs;
 }
+
+/* Generic negative-sampling pair trainer: rows[i] (input vector in syn0)
+ * predicts targets[i] (output row in syn1neg), negatives from the
+ * unigram table.  The DBOW hot loop (reference: sequence/DBOW.java — a
+ * document's label row predicts every document word) is exactly this
+ * with rows = label per position; also reusable for any pre-generated
+ * pair stream.  Same LR decay / sigmoid table / LCG as skipgram_train. */
+long pairs_train(float *syn0, float *syn1neg, long layer,
+                 const int *rows, const int *targets, long n_pairs,
+                 const int *table, long table_len,
+                 int negative, float alpha, float min_alpha, int epochs,
+                 unsigned long long seed) {
+    if (!exp_table_ready) build_exp_table();
+    if (layer > 4096) return -1;
+    long done = 0;
+    long total = n_pairs * (long)epochs;
+    unsigned long long rng = seed ? seed : 1ULL;
+    float neu1e[4096];
+
+    for (int ep = 0; ep < epochs; ep++) {
+        for (long i = 0; i < n_pairs; i++) {
+            int r = rows[i];
+            int w = targets[i];
+            if (r < 0 || w < 0) continue;
+            done++;
+            float lr = alpha * (1.0f - (float)done / (float)(total + 1));
+            if (lr < min_alpha) lr = min_alpha;
+            float *in = syn0 + (long)r * layer;
+            for (long k = 0; k < layer; k++) neu1e[k] = 0.0f;
+            for (int d = 0; d < negative + 1; d++) {
+                long target;
+                float label;
+                if (d == 0) {
+                    target = w;
+                    label = 1.0f;
+                } else {
+                    target = table[(next_rand(&rng) >> 16) % table_len];
+                    if (target == w) continue;
+                    label = 0.0f;
+                }
+                float *out = syn1neg + target * layer;
+                float dot = 0.0f;
+                for (long k = 0; k < layer; k++) dot += in[k] * out[k];
+                float g = (label - fast_sigmoid(dot)) * lr;
+                for (long k = 0; k < layer; k++) {
+                    neu1e[k] += g * out[k];
+                    out[k] += g * in[k];
+                }
+            }
+            for (long k = 0; k < layer; k++) in[k] += neu1e[k];
+        }
+    }
+    return done;
+}

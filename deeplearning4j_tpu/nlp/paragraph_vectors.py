@@ -63,6 +63,41 @@ class ParagraphVectors(SequenceVectors):
         self.train_words = train_words
         self._label_ids: dict = {}
 
+    # --------------------------------------------------------------- native
+    def _native_eligible_config(self) -> bool:
+        """PV refinement of the SequenceVectors eligibility: the native
+        pair kernel (native/skipgram.c pairs_train — the DBOW.java hot
+        loop) covers plain-NS DBOW without word co-training; DM,
+        hierarchic softmax, subsampling, and train_words keep the device
+        path. Composes with the shared gate so the common rule set lives
+        in one place."""
+        return (self._native_common_eligible()
+                and self.sequence_algorithm == "dbow"
+                and not self.train_words)
+
+    def _fit_native_dbow(self, entries) -> bool:
+        """Train label->word NS pairs in the native kernel (the same
+        sequential-accumulation semantics as the reference's DBOW.java),
+        tables host-side like Word2Vec's native path. Returns False when
+        the native library is unavailable (caller uses the device path
+        with the same entries)."""
+        from deeplearning4j_tpu.native import ns_pairs_train
+
+        rows = np.concatenate(
+            [np.full(idx.size, label_row, np.int32)
+             for idx, label_row in entries])
+        targets = np.concatenate(
+            [np.asarray(idx, np.int32) for idx, _ in entries])
+        syn0, syn1neg, table = self._native_tables()
+        out = ns_pairs_train(
+            syn0, syn1neg, rows, targets, table, negative=self.negative,
+            alpha=self.learning_rate, min_alpha=self.min_learning_rate,
+            epochs=self.epochs * self.iterations, seed=self.seed or 1)
+        if out is None:  # toolchain raced away: caller falls through to
+            return False  # the device path with the same entries
+        _, self.syn0, self.syn1neg = out
+        return True
+
     # ------------------------------------------------------------------ vocab
     def _label_token(self, label: str) -> str:
         return self.LABEL_PREFIX + label
@@ -123,6 +158,8 @@ class ParagraphVectors(SequenceVectors):
                 entries.append((idx, self._label_ids[label]))
                 total_tokens += idx.size
         if not entries:
+            return self
+        if self._use_native_backend() and self._fit_native_dbow(entries):
             return self
         B, W, K = self.batch_size, self.window, self.negative
         if self.use_hs:

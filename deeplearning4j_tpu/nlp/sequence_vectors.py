@@ -140,26 +140,48 @@ class SequenceVectors:
         if self.backend == "native":
             if not eligible:
                 raise ValueError(
-                    "backend='native' supports plain negative-sampling "
-                    "skip-gram on unsharded tables only (no HS, no "
-                    "subsampling, no CBOW), and needs the C toolchain")
+                    "backend='native' requires a config the native "
+                    "kernels support — plain negative-sampling skip-gram "
+                    "(Word2Vec) or DBOW without train_words "
+                    "(ParagraphVectors) on unsharded tables; no HS, no "
+                    "subsampling, no CBOW/DM — and the C toolchain")
             return True
         return eligible
 
-    def _native_eligible_config(self) -> bool:
-        """Config-level (pre-array) native-backend eligibility.
-        layer_size is part of it: the C kernel's accumulator is a fixed
-        4096-float buffer (native/skipgram.c) and a runtime rejection
-        there would otherwise silently fall back AFTER consuming a
-        possibly non-restartable sentence stream."""
-        from deeplearning4j_tpu.native import skipgram_native_available
+    def _native_common_eligible(self) -> bool:
+        """Conditions shared by every native kernel (subclass eligibility
+        composes with this — one place for the rule set). layer_size is
+        part of it: the C accumulator is a fixed NATIVE_MAX_LAYER buffer
+        and a runtime rejection would otherwise silently fall back AFTER
+        consuming a possibly non-restartable sentence stream."""
+        from deeplearning4j_tpu.native import (NATIVE_MAX_LAYER,
+                                               skipgram_native_available)
 
         return (self.backend != "device"
-                and self.elements_algorithm == "skipgram"
                 and not self.use_hs and self.negative > 0
                 and self.sampling == 0.0
-                and self.layer_size <= 4096
+                and self.layer_size <= NATIVE_MAX_LAYER
                 and skipgram_native_available())
+
+    def _native_eligible_config(self) -> bool:
+        """Config-level (pre-array) native-backend eligibility."""
+        return (self._native_common_eligible()
+                and self.elements_algorithm == "skipgram")
+
+    def _native_tables(self):
+        """(syn0, syn1neg, unigram^0.75 table) as host arrays for the C
+        kernels. Host tables train in place; a device-resident table is
+        pulled once (and stays host-side after — queries convert on
+        demand). One implementation for every native consumer."""
+        counts = self.vocab.counts_array()
+        p = counts ** 0.75
+        p /= p.sum()
+        table = np.repeat(np.arange(len(p), dtype=np.int32),
+                          np.maximum(1, (p * 1_000_000).astype(np.int64)))
+        syn0 = np.ascontiguousarray(np.asarray(self.syn0), np.float32)
+        syn1neg = np.ascontiguousarray(np.asarray(self.syn1neg),
+                                       np.float32)
+        return syn0, syn1neg, table
 
     def _fit_native(self, sentences) -> "SequenceVectors":
         """Train via native/skipgram.c in place of the jitted epoch."""
@@ -182,15 +204,7 @@ class SequenceVectors:
                 corpus.append(-1)
         if not corpus:
             return self
-        counts = cache.counts_array()
-        p = counts ** 0.75
-        p /= p.sum()
-        table = np.repeat(np.arange(len(p), dtype=np.int32),
-                          np.maximum(1, (p * 1_000_000).astype(np.int64)))
-        # host tables train in place; a device-resident table is pulled
-        # once (and stays host-side after — queries convert on demand)
-        syn0 = np.ascontiguousarray(np.asarray(self.syn0), np.float32)
-        syn1neg = np.ascontiguousarray(np.asarray(self.syn1neg), np.float32)
+        syn0, syn1neg, table = self._native_tables()
         out = skipgram_train(
             syn0, syn1neg, np.asarray(corpus, np.int32), table,
             window=self.window, negative=self.negative,

@@ -559,3 +559,68 @@ class TestBagOfWords:
         i_cat = tv.vocab.index_of("cat")
         assert v[i_the] == 0.0          # idf(the) = log(3/3) = 0
         assert v[i_cat] > 0.0
+
+
+class TestNativeDoc2Vec:
+    def test_native_dbow_learns_doc_structure(self):
+        """The native pair kernel (DBOW.java analog) trains document
+        vectors that separate two topics, mirroring the device-path
+        classification test."""
+        from deeplearning4j_tpu.native import skipgram_native_available
+        from deeplearning4j_tpu.nlp import ParagraphVectors
+        from deeplearning4j_tpu.nlp.tokenization import LabelledDocument
+
+        if not skipgram_native_available():
+            pytest.skip("no C toolchain")
+        rs = np.random.RandomState(0)
+        day = ["day", "sun", "light", "bright", "warm"]
+        night = ["night", "moon", "dark", "star", "cold"]
+        docs = []
+        for i in range(60):
+            topic, lab = (day, "d") if i % 2 == 0 else (night, "n")
+            docs.append(LabelledDocument(
+                " ".join(topic[rs.randint(5)] for _ in range(12)),
+                f"{lab}{i}"))
+        pv = ParagraphVectors(layer_size=24, window=3, min_word_frequency=1,
+                              negative=5, use_hierarchic_softmax=False,
+                              epochs=8, seed=3)
+        assert pv.backend == "auto"
+        pv.build_vocab_from_documents(docs)
+        pv.reset_weights()
+        assert pv._native_eligible_config()
+        pv.fit(docs)
+        # same-topic doc vectors must be closer than cross-topic
+        import numpy as np_
+        vecs = {d.labels[0]: np_.asarray(
+            pv.syn0[pv._label_ids[d.labels[0]]]) for d in docs}
+
+        def cos(a, b):
+            return float(a @ b / (np_.linalg.norm(a) * np_.linalg.norm(b)
+                                  + 1e-9))
+        same = np_.mean([cos(vecs[f"d{i}"], vecs[f"d{i+2}"])
+                         for i in range(0, 20, 2)])
+        cross = np_.mean([cos(vecs[f"d{i}"], vecs[f"n{i+1}"])
+                          for i in range(0, 20, 2)])
+        assert same > cross, (same, cross)
+
+    def test_native_dbow_routing_rules(self):
+        from deeplearning4j_tpu.native import skipgram_native_available
+        from deeplearning4j_tpu.nlp import ParagraphVectors
+
+        if not skipgram_native_available():
+            pytest.skip("no C toolchain")
+
+        def pv(**kw):
+            return ParagraphVectors(layer_size=8, min_word_frequency=1,
+                                    **kw)
+
+        assert pv(negative=5, use_hierarchic_softmax=False
+                  )._native_eligible_config()
+        assert not pv(negative=5, use_hierarchic_softmax=False,
+                      backend="device")._native_eligible_config()
+        assert not pv(negative=5, use_hierarchic_softmax=False,
+                      sequence_algorithm="dm")._native_eligible_config()
+        assert not pv(negative=5, use_hierarchic_softmax=False,
+                      train_words=True)._native_eligible_config()
+        assert not pv(negative=0, use_hierarchic_softmax=True
+                      )._native_eligible_config()
