@@ -233,3 +233,70 @@ def ns_pairs_train(syn0, syn1neg, rows, targets, table, *, negative: int,
     if n < 0:
         return None
     return n, syn0, syn1neg
+
+
+def _bind_cbow(lib):
+    """Bind cbow_train, or None when the loaded .so predates it."""
+    if not hasattr(lib, "cbow_train"):
+        return None
+    if not hasattr(lib, "_cbow_bound"):
+        lib.cbow_train.restype = ctypes.c_long
+        lib.cbow_train.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.c_int), ctypes.c_long,
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int), ctypes.c_long,
+            ctypes.c_int, ctypes.c_int,
+            ctypes.c_float, ctypes.c_float, ctypes.c_int,
+            ctypes.c_ulonglong]
+        lib._cbow_bound = True
+    return lib
+
+
+NATIVE_MAX_WINDOW = 64  # fixed context buffer in cbow_train
+
+
+def cbow_native_available() -> bool:
+    """The loaded .so exports cbow_train (a stale artifact may not)."""
+    lib = _load_skipgram()
+    return lib is not None and _bind_cbow(lib) is not None
+
+
+def pairs_native_available() -> bool:
+    """The loaded .so exports pairs_train (a stale artifact may not)."""
+    lib = _load_skipgram()
+    return lib is not None and _bind_pairs(lib) is not None
+
+
+def cbow_train(syn0, syn1neg, corpus, table, *, window: int, negative: int,
+               alpha: float, min_alpha: float, epochs: int = 1,
+               seed: int = 1, labels=None):
+    """In-place native CBOW/DM training (CBOW.java / DM.java hot loop):
+    the averaged context window — plus the per-position ``labels`` row
+    for DM — predicts the center word via negative sampling. Returns
+    trained position count + updated arrays, or None when native is
+    unavailable."""
+    lib = _load_skipgram()
+    if lib is None or _bind_cbow(lib) is None:
+        return None
+    syn0 = np.ascontiguousarray(syn0, np.float32)
+    syn1neg = np.ascontiguousarray(syn1neg, np.float32)
+    corpus = np.ascontiguousarray(corpus, np.int32)
+    table = np.ascontiguousarray(table, np.int32)
+    fp = ctypes.POINTER(ctypes.c_float)
+    ip = ctypes.POINTER(ctypes.c_int)
+    if labels is not None:
+        labels = np.ascontiguousarray(labels, np.int32)
+        lab_ptr = labels.ctypes.data_as(ip)
+    else:
+        lab_ptr = None
+    n = lib.cbow_train(
+        syn0.ctypes.data_as(fp), syn1neg.ctypes.data_as(fp),
+        syn0.shape[1],
+        corpus.ctypes.data_as(ip), len(corpus), lab_ptr,
+        table.ctypes.data_as(ip), len(table),
+        window, negative, alpha, min_alpha, epochs, seed)
+    if n < 0:
+        return None
+    return n, syn0, syn1neg

@@ -63,6 +63,7 @@ long skipgram_train(float *syn0, float *syn1neg, long vocab, long layer,
                     unsigned long long seed) {
     (void)vocab;
     if (!exp_table_ready) build_exp_table();
+    if (window < 1) return -1; /* %0 in the reduced-window draw = SIGFPE */
     long pairs = 0;
     long total = (long)corpus_len * epochs;
     long seen = 0;
@@ -174,4 +175,88 @@ long pairs_train(float *syn0, float *syn1neg, long layer,
         }
     }
     return done;
+}
+
+/* CBOW / DM hot loop (reference: impl/elements/CBOW.java and
+ * sequence/DM.java — DM is CBOW with the document's label row prepended
+ * to every context window):  the averaged context (plus optional label
+ * row) predicts the center word through negative sampling; the gradient
+ * is distributed back to every contributing row.  labels may be NULL
+ * (plain CBOW) or hold one syn0 row id per corpus position (-1 = none).
+ * Same LR decay / sigmoid table / LCG as skipgram_train. */
+long cbow_train(float *syn0, float *syn1neg, long layer,
+                const int *corpus, long corpus_len,
+                const int *labels,
+                const int *table, long table_len,
+                int window, int negative,
+                float alpha, float min_alpha, int epochs,
+                unsigned long long seed) {
+    if (!exp_table_ready) build_exp_table();
+    if (layer > 4096) return -1;
+    if (window < 1) return -1; /* %0 in the reduced-window draw = SIGFPE */
+    long trained = 0;
+    long total = (long)corpus_len * epochs;
+    long seen = 0;
+    unsigned long long rng = seed ? seed : 1ULL;
+    float neu1[4096], neu1e[4096];
+    long ctx[2 * 64 + 1]; /* window <= 64 plus the optional label row */
+    if (window > 64) return -1;
+
+    for (int ep = 0; ep < epochs; ep++) {
+        long sent_start = 0;
+        for (long pos = 0; pos < corpus_len; pos++) {
+            int w = corpus[pos];
+            if (w < 0) { sent_start = pos + 1; continue; }
+            seen++;
+            float lr = alpha * (1.0f - (float)seen / (float)(total + 1));
+            if (lr < min_alpha) lr = min_alpha;
+            int b = (int)(next_rand(&rng) % (unsigned)window);
+            long n_ctx = 0;
+            for (long cpos = pos - window + b; cpos <= pos + window - b;
+                 cpos++) {
+                if (cpos == pos || cpos < sent_start || cpos >= corpus_len)
+                    continue;
+                int c = corpus[cpos];
+                if (c < 0) break;
+                ctx[n_ctx++] = c;
+            }
+            if (labels && labels[pos] >= 0)
+                ctx[n_ctx++] = labels[pos];
+            if (n_ctx == 0) continue;
+            float inv = 1.0f / (float)n_ctx;
+            for (long k = 0; k < layer; k++) {
+                float acc = 0.0f;
+                for (long j = 0; j < n_ctx; j++)
+                    acc += syn0[ctx[j] * layer + k];
+                neu1[k] = acc * inv;
+                neu1e[k] = 0.0f;
+            }
+            for (int d = 0; d < negative + 1; d++) {
+                long target;
+                float label;
+                if (d == 0) {
+                    target = w;
+                    label = 1.0f;
+                } else {
+                    target = table[(next_rand(&rng) >> 16) % table_len];
+                    if (target == w) continue;
+                    label = 0.0f;
+                }
+                float *out = syn1neg + target * layer;
+                float dot = 0.0f;
+                for (long k = 0; k < layer; k++) dot += neu1[k] * out[k];
+                float g = (label - fast_sigmoid(dot)) * lr;
+                for (long k = 0; k < layer; k++) {
+                    neu1e[k] += g * out[k];
+                    out[k] += g * neu1[k];
+                }
+            }
+            for (long j = 0; j < n_ctx; j++) {
+                float *in = syn0 + ctx[j] * layer;
+                for (long k = 0; k < layer; k++) in[k] += neu1e[k];
+            }
+            trained++;
+        }
+    }
+    return trained;
 }

@@ -66,33 +66,58 @@ class ParagraphVectors(SequenceVectors):
     # --------------------------------------------------------------- native
     def _native_eligible_config(self) -> bool:
         """PV refinement of the SequenceVectors eligibility: the native
-        pair kernel (native/skipgram.c pairs_train — the DBOW.java hot
-        loop) covers plain-NS DBOW without word co-training; DM,
-        hierarchic softmax, subsampling, and train_words keep the device
-        path. Composes with the shared gate so the common rule set lives
-        in one place."""
-        return (self._native_common_eligible()
-                and self.sequence_algorithm == "dbow"
-                and not self.train_words)
+        kernels (native/skipgram.c pairs_train / cbow_train — the
+        DBOW.java and DM.java hot loops) cover plain-NS DBOW and DM
+        without word co-training; hierarchic softmax, subsampling, and
+        train_words keep the device path. Composes with the shared gate
+        so the common rule set lives in one place."""
+        from deeplearning4j_tpu.native import (NATIVE_MAX_WINDOW,
+                                               cbow_native_available,
+                                               pairs_native_available)
 
-    def _fit_native_dbow(self, entries) -> bool:
-        """Train label->word NS pairs in the native kernel (the same
-        sequential-accumulation semantics as the reference's DBOW.java),
-        tables host-side like Word2Vec's native path. Returns False when
-        the native library is unavailable (caller uses the device path
-        with the same entries)."""
-        from deeplearning4j_tpu.native import ns_pairs_train
+        if not (self._native_common_eligible()
+                and not self.train_words):
+            return False
+        if self.sequence_algorithm == "dbow":
+            return pairs_native_available()
+        return (self.sequence_algorithm == "dm"
+                and 1 <= self.window <= NATIVE_MAX_WINDOW
+                and cbow_native_available())
 
-        rows = np.concatenate(
-            [np.full(idx.size, label_row, np.int32)
-             for idx, label_row in entries])
-        targets = np.concatenate(
-            [np.asarray(idx, np.int32) for idx, _ in entries])
+    def _fit_native_docs(self, entries) -> bool:
+        """Train documents in the native kernels with the reference's
+        sequential-accumulation semantics — DBOW as label->word NS pairs
+        (DBOW.java), DM as CBOW windows with the label row appended to
+        every context (DM.java) — tables host-side like Word2Vec's
+        native path. Returns False when the native library is
+        unavailable (caller uses the device path with the same
+        entries)."""
+        from deeplearning4j_tpu.native import cbow_train, ns_pairs_train
+
         syn0, syn1neg, table = self._native_tables()
-        out = ns_pairs_train(
-            syn0, syn1neg, rows, targets, table, negative=self.negative,
-            alpha=self.learning_rate, min_alpha=self.min_learning_rate,
-            epochs=self.epochs * self.iterations, seed=self.seed or 1)
+        common = dict(negative=self.negative, alpha=self.learning_rate,
+                      min_alpha=self.min_learning_rate,
+                      epochs=self.epochs * self.iterations,
+                      seed=self.seed or 1)
+        if self.sequence_algorithm == "dbow":
+            rows = np.concatenate(
+                [np.full(idx.size, label_row, np.int32)
+                 for idx, label_row in entries])
+            targets = np.concatenate(
+                [np.asarray(idx, np.int32) for idx, _ in entries])
+            out = ns_pairs_train(syn0, syn1neg, rows, targets, table,
+                                 **common)
+        else:  # dm
+            sep = np.asarray([-1], np.int32)
+            corpus = np.concatenate(
+                [np.concatenate([np.asarray(idx, np.int32), sep])
+                 for idx, _ in entries])
+            labels = np.concatenate(
+                [np.concatenate([np.full(idx.size, label_row, np.int32),
+                                 sep])
+                 for idx, label_row in entries])
+            out = cbow_train(syn0, syn1neg, corpus, table,
+                             window=self.window, labels=labels, **common)
         if out is None:  # toolchain raced away: caller falls through to
             return False  # the device path with the same entries
         _, self.syn0, self.syn1neg = out
@@ -159,7 +184,7 @@ class ParagraphVectors(SequenceVectors):
                 total_tokens += idx.size
         if not entries:
             return self
-        if self._use_native_backend() and self._fit_native_dbow(entries):
+        if self._use_native_backend() and self._fit_native_docs(entries):
             return self
         B, W, K = self.batch_size, self.window, self.negative
         if self.use_hs:

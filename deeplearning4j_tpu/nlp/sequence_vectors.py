@@ -126,10 +126,10 @@ class SequenceVectors:
         libnd4j kernel, not JVM code): plain negative-sampling skip-gram
         is a scatter-bound workload a CPU inner loop beats the device
         scatter path at (measured 210k vs 184k words/s on the bench
-        config, profiles/w2v_baseline.py). The device path keeps every
-        other case: CBOW, hierarchic softmax, subsampling, and SHARDED
-        embedding tables (nlp/distributed.py EP training), which the
-        host loop cannot see."""
+        config, profiles/w2v_baseline.py); CBOW has its own native
+        kernel. The device path keeps hierarchic softmax, subsampling,
+        and SHARDED embedding tables (nlp/distributed.py EP training),
+        which the host loops cannot see."""
         from deeplearning4j_tpu.native import skipgram_native_available
 
         if self.backend == "device":
@@ -141,10 +141,10 @@ class SequenceVectors:
             if not eligible:
                 raise ValueError(
                     "backend='native' requires a config the native "
-                    "kernels support — plain negative-sampling skip-gram "
-                    "(Word2Vec) or DBOW without train_words "
+                    "kernels support — negative-sampling skip-gram/CBOW "
+                    "(Word2Vec) or DBOW / DM without train_words "
                     "(ParagraphVectors) on unsharded tables; no HS, no "
-                    "subsampling, no CBOW/DM — and the C toolchain")
+                    "subsampling — and the C toolchain")
             return True
         return eligible
 
@@ -164,9 +164,20 @@ class SequenceVectors:
                 and skipgram_native_available())
 
     def _native_eligible_config(self) -> bool:
-        """Config-level (pre-array) native-backend eligibility."""
-        return (self._native_common_eligible()
-                and self.elements_algorithm == "skipgram")
+        """Config-level (pre-array) native-backend eligibility. The
+        per-kernel availability probes guard against a stale .so missing
+        the newer symbols — a runtime rejection would otherwise fall back
+        AFTER consuming a possibly non-restartable sentence stream."""
+        from deeplearning4j_tpu.native import (NATIVE_MAX_WINDOW,
+                                               cbow_native_available)
+
+        if not (self._native_common_eligible() and self.window >= 1):
+            return False
+        if self.elements_algorithm == "skipgram":
+            return True
+        return (self.elements_algorithm == "cbow"
+                and self.window <= NATIVE_MAX_WINDOW
+                and cbow_native_available())
 
     def _native_tables(self):
         """(syn0, syn1neg, unigram^0.75 table) as host arrays for the C
@@ -184,8 +195,9 @@ class SequenceVectors:
         return syn0, syn1neg, table
 
     def _fit_native(self, sentences) -> "SequenceVectors":
-        """Train via native/skipgram.c in place of the jitted epoch."""
-        from deeplearning4j_tpu.native import skipgram_train
+        """Train via native/skipgram.c in place of the jitted epoch
+        (skip-gram or CBOW — the AggregateSkipGram / CBOW.java loops)."""
+        from deeplearning4j_tpu.native import cbow_train, skipgram_train
 
         if hasattr(sentences, "reset"):
             sentences.reset()
@@ -205,7 +217,9 @@ class SequenceVectors:
         if not corpus:
             return self
         syn0, syn1neg, table = self._native_tables()
-        out = skipgram_train(
+        kernel = (skipgram_train if self.elements_algorithm == "skipgram"
+                  else cbow_train)
+        out = kernel(
             syn0, syn1neg, np.asarray(corpus, np.int32), table,
             window=self.window, negative=self.negative,
             alpha=self.learning_rate, min_alpha=self.min_learning_rate,

@@ -129,9 +129,10 @@ class TestWord2Vec:
             w2v.similarity("night", "sun")
 
     def test_native_backend_routing_rules(self):
-        """auto: plain NS skip-gram routes native; HS / CBOW / device pin
-        stay on the device path; native pin on an ineligible config
-        raises instead of silently training differently."""
+        """auto: plain NS skip-gram and CBOW route native; HS / device
+        pin / oversize windows stay on the device path; native pin on an
+        ineligible config raises instead of silently training
+        differently."""
         from deeplearning4j_tpu.native import skipgram_native_available
 
         if not skipgram_native_available():
@@ -139,8 +140,8 @@ class TestWord2Vec:
         corpus = _synthetic_corpus(60)
 
         def built(**kw):
-            w2v = Word2Vec(layer_size=8, window=2, min_word_frequency=1,
-                           **kw)
+            kw.setdefault("window", 2)
+            w2v = Word2Vec(layer_size=8, min_word_frequency=1, **kw)
             w2v.build_vocab(corpus)
             w2v.reset_weights()
             return w2v
@@ -151,8 +152,13 @@ class TestWord2Vec:
                          )._use_native_backend()
         assert not built(negative=5, use_hierarchic_softmax=False,
                          backend="device")._use_native_backend()
+        # CBOW is native-eligible too (cbow_train) — up to the kernel's
+        # context-buffer window cap
+        assert built(negative=5, use_hierarchic_softmax=False,
+                     elements_algorithm="cbow")._use_native_backend()
         assert not built(negative=5, use_hierarchic_softmax=False,
-                         elements_algorithm="cbow")._use_native_backend()
+                         elements_algorithm="cbow",
+                         window=65)._use_native_backend()
         with pytest.raises(ValueError, match="native"):
             built(negative=0, use_hierarchic_softmax=True,
                   backend="native")._use_native_backend()
@@ -618,9 +624,61 @@ class TestNativeDoc2Vec:
                   )._native_eligible_config()
         assert not pv(negative=5, use_hierarchic_softmax=False,
                       backend="device")._native_eligible_config()
-        assert not pv(negative=5, use_hierarchic_softmax=False,
-                      sequence_algorithm="dm")._native_eligible_config()
+        # DM is native-eligible too since the CBOW/DM kernel landed
+        assert pv(negative=5, use_hierarchic_softmax=False,
+                  sequence_algorithm="dm")._native_eligible_config()
         assert not pv(negative=5, use_hierarchic_softmax=False,
                       train_words=True)._native_eligible_config()
         assert not pv(negative=0, use_hierarchic_softmax=True
                       )._native_eligible_config()
+
+
+class TestNativeCbowDm:
+    def test_native_cbow_learns_topic_structure(self):
+        from deeplearning4j_tpu.native import skipgram_native_available
+
+        if not skipgram_native_available():
+            pytest.skip("no C toolchain")
+        corpus = _synthetic_corpus()
+        w2v = Word2Vec(layer_size=32, window=4, min_word_frequency=3,
+                       epochs=6, negative=5, use_hierarchic_softmax=False,
+                       elements_algorithm="cbow", learning_rate=0.05,
+                       seed=3, backend="native")
+        w2v.fit(CollectionSentenceIterator(corpus))
+        assert w2v.similarity("day", "sun") > w2v.similarity("day", "moon")
+        assert w2v.similarity("night", "moon") > \
+            w2v.similarity("night", "sun")
+
+    def test_native_dm_learns_doc_structure(self):
+        from deeplearning4j_tpu.native import skipgram_native_available
+
+        if not skipgram_native_available():
+            pytest.skip("no C toolchain")
+        rs = np.random.RandomState(1)
+        day = ["day", "sun", "light", "bright", "warm"]
+        night = ["night", "moon", "dark", "star", "cold"]
+        docs = []
+        for i in range(60):
+            topic, lab = (day, "d") if i % 2 == 0 else (night, "n")
+            docs.append(LabelledDocument(
+                " ".join(topic[rs.randint(5)] for _ in range(12)),
+                f"{lab}{i}"))
+        pv = ParagraphVectors(layer_size=24, window=3, min_word_frequency=1,
+                              negative=5, use_hierarchic_softmax=False,
+                              epochs=10, seed=3, sequence_algorithm="dm",
+                              backend="native")
+        pv.build_vocab_from_documents(docs)
+        pv.reset_weights()
+        assert pv._native_eligible_config()
+        pv.fit(docs)
+        vecs = {d.labels[0]: np.asarray(
+            pv.syn0[pv._label_ids[d.labels[0]]]) for d in docs}
+
+        def cos(a, b):
+            return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b)
+                                  + 1e-9))
+        same = np.mean([cos(vecs[f"d{i}"], vecs[f"d{i+2}"])
+                        for i in range(0, 20, 2)])
+        cross = np.mean([cos(vecs[f"d{i}"], vecs[f"n{i+1}"])
+                         for i in range(0, 20, 2)])
+        assert same > cross, (same, cross)
