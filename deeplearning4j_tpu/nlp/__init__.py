@@ -24,6 +24,7 @@ from deeplearning4j_tpu.nlp.vocab import (
 from deeplearning4j_tpu.nlp.tokenization import (
     CollectionSentenceIterator,
     CommonPreprocessor,
+    CjkTokenizerFactory,
     DefaultTokenizerFactory,
     FileSentenceIterator,
     LineSentenceIterator,
@@ -36,6 +37,6 @@ from deeplearning4j_tpu.nlp.glove import Glove
 __all__ = [
     "AbstractCache", "Huffman", "VocabConstructor", "VocabWord",
     "CollectionSentenceIterator", "CommonPreprocessor",
-    "DefaultTokenizerFactory", "FileSentenceIterator", "LineSentenceIterator",
+    "CjkTokenizerFactory", "DefaultTokenizerFactory", "FileSentenceIterator", "LineSentenceIterator",
     "SequenceVectors", "Word2Vec", "ParagraphVectors", "Glove",
 ]

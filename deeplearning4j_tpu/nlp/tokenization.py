@@ -4,9 +4,11 @@ Reference: deeplearning4j-nlp text/tokenization/tokenizerfactory/
 (DefaultTokenizerFactory, TokenizerFactory SPI), tokenizer/preprocessor/
 (CommonPreprocessor, EndingPreProcessor), text/sentenceiterator/
 (CollectionSentenceIterator, FileSentenceIterator, LineSentenceIterator,
-LabelAwareSentenceIterator). Vendored CJK analyzers (ansj/kuromoji, ~17k LoC
-of third-party Java) are out of scope; the TokenizerFactory SPI is the hook
-where equivalents would plug in.
+LabelAwareSentenceIterator). The reference's vendored dictionary analyzers
+(ansj/kuromoji, ~19.7k LoC of third-party Java) stay out of scope, but a
+first-party ``CjkTokenizerFactory`` (script-aware character-bigram
+segmentation) covers the basic CJK capability behind the same
+TokenizerFactory SPI; a dictionary segmenter plugs in the same way.
 """
 
 from __future__ import annotations
@@ -90,6 +92,77 @@ class DefaultTokenizerFactory(TokenizerFactory):
 
     def create(self, text: str) -> Tokenizer:
         return Tokenizer(text, self._pre)
+
+    def set_token_pre_processor(self, pre: TokenPreProcess) -> None:
+        self._pre = pre
+
+
+class _CjkSegmentingTokenizer(Tokenizer):
+    """Script-aware tokenizer: CJK spans (which carry no whitespace word
+    boundaries) are segmented into overlapping character bigrams — the
+    standard statistical fallback the reference's vendored analyzers
+    (kuromoji for Japanese, smartcn-style segmenters for Chinese) improve
+    on with dictionaries; non-CJK spans keep whitespace tokenization and
+    CJK punctuation acts as a token boundary (CommonPreprocessor's ASCII
+    regex cannot strip it downstream). First-party and dependency-free;
+    plug a dictionary segmenter through the same TokenizerFactory SPI
+    when higher quality is needed."""
+
+    _CJK_RANGES = (
+        (0x3005, 0x3007),    # 々 iteration mark, 〆, 〇
+        (0x3040, 0x30FF),    # hiragana + katakana
+        (0x31F0, 0x31FF),    # katakana phonetic extensions
+        (0x3400, 0x4DBF),    # CJK ext A
+        (0x4E00, 0x9FFF),    # CJK unified
+        (0xAC00, 0xD7AF),    # hangul syllables
+        (0xF900, 0xFAFF),    # CJK compat ideographs
+        (0xFF66, 0xFF9F),    # halfwidth katakana
+        (0x20000, 0x2FA1F),  # CJK ext B..F + compat supplement
+    )
+    # ideographic punctuation / fullwidth sentence marks: boundaries,
+    # never tokens (they would otherwise flood the vocab — ASCII-focused
+    # preprocessors cannot strip them)
+    _CJK_PUNCT = set("\u3001\u3002\u30fb\u30fc\uff01\uff08\uff09"
+                     "\uff0c\uff0e\uff1a\uff1b\uff1f\u300c\u300d"
+                     "\u300e\u300f\u3008\u3009\u2026\u301c\uff5e")
+
+    @classmethod
+    def _char_class(cls, ch: str) -> str:
+        if ch in cls._CJK_PUNCT:
+            return "punct"
+        o = ord(ch)
+        if any(lo <= o <= hi for lo, hi in cls._CJK_RANGES):
+            return "cjk"
+        return "other"
+
+    def __init__(self, text: str, preprocessor: Optional[TokenPreProcess]):
+        import itertools
+
+        tokens = []
+        for chunk in text.split():
+            for cls_, grp in itertools.groupby(chunk, key=self._char_class):
+                run = "".join(grp)
+                if cls_ == "punct":
+                    continue  # boundary, not a token
+                if cls_ == "other" or len(run) == 1:
+                    tokens.append(run)
+                else:  # overlapping character bigrams
+                    tokens.extend(run[i:i + 2]
+                                  for i in range(len(run) - 1))
+        self._tokens = [t for t in tokens if t]
+        self._pre = preprocessor
+
+
+class CjkTokenizerFactory(TokenizerFactory):
+    """Character-bigram CJK tokenizer factory (the first-party analog of
+    the reference's vendored tokenizers.cjk / kuromoji analyzers, behind
+    the same TokenizerFactory SPI)."""
+
+    def __init__(self, preprocessor: Optional[TokenPreProcess] = None):
+        self._pre = preprocessor
+
+    def create(self, text: str) -> Tokenizer:
+        return _CjkSegmentingTokenizer(text, self._pre)
 
     def set_token_pre_processor(self, pre: TokenPreProcess) -> None:
         self._pre = pre

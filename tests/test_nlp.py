@@ -682,3 +682,55 @@ class TestNativeCbowDm:
         cross = np.mean([cos(vecs[f"d{i}"], vecs[f"n{i+1}"])
                          for i in range(0, 20, 2)])
         assert same > cross, (same, cross)
+
+
+class TestCjkTokenizer:
+    def test_cjk_bigrams_and_mixed_scripts(self):
+        from deeplearning4j_tpu.nlp import CjkTokenizerFactory
+
+        tf = CjkTokenizerFactory()
+        # pure CJK run -> overlapping character bigrams
+        assert tf.create("深度学习").tokens() == \
+            ["深度", "度学", "学习"]
+        # single CJK char stands alone
+        assert tf.create("学").tokens() == ["学"]
+        # mixed latin + CJK inside one whitespace chunk splits by script
+        toks = tf.create("TPU深度 learning").tokens()
+        assert toks == ["TPU", "深度", "learning"]
+        # hangul + hiragana ranges covered
+        assert tf.create("한국어").tokens() == \
+            ["한국", "국어"]
+        assert tf.create("ひらがな").tokens() == \
+            ["ひら", "らが", "がな"]
+        # iteration mark joins its run; halfwidth katakana and Ext-B
+        # supplementary-plane ideographs are segmented too
+        assert tf.create("人々の時々").tokens() == \
+            ["人々", "々の", "の時", "時々"]
+        assert tf.create("ｶﾀｶﾅ").tokens() == ["ｶﾀ", "ﾀｶ", "ｶﾅ"]
+        assert "𠮷野" in tf.create("𠮷野家").tokens()
+        # ideographic punctuation is a boundary, never a token
+        assert tf.create("深度学习。音乐！").tokens() == \
+            ["深度", "度学", "学习", "音乐"]
+
+    def test_word2vec_trains_on_cjk_corpus(self):
+        """The factory plugs into the SPI end-to-end: embeddings learn
+        co-occurrence structure from an unspaced CJK corpus."""
+        from deeplearning4j_tpu.nlp import CjkTokenizerFactory
+
+        rs = np.random.RandomState(0)
+        # two "topics" of CJK characters; sentences are unspaced runs
+        a = "深度学习模型"   # topic A chars
+        b = "音乐歌曲舞蹈"   # topic B chars
+        sents = []
+        for _ in range(300):
+            src = a if rs.rand() < 0.5 else b
+            sents.append("".join(src[rs.randint(len(src))]
+                                 for _ in range(8)))
+        w2v = Word2Vec(layer_size=24, window=3, min_word_frequency=2,
+                       negative=5, use_hierarchic_softmax=False, epochs=4,
+                       seed=5, tokenizer_factory=CjkTokenizerFactory())
+        w2v.fit(CollectionSentenceIterator(sents))
+        # bigrams from the same topic must be closer than cross-topic
+        va, vb = a[:2], a[2:4]
+        vc = b[:2]
+        assert w2v.similarity(va, vb) > w2v.similarity(va, vc)
