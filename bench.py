@@ -328,6 +328,58 @@ def bench_attention_bwd(B: int = 4, H: int = 8, T: int = 2048, d: int = 128,
             _attn_chained_ms(flash, B, H, T, d, steps, "attention bwd"))
 
 
+def bench_fit_e2e(batch: int = 1, n_examples: int = 96, reps: int = 5):
+    """LeNet-MNIST ``fit()`` wall clock, END TO END — the user-facing path
+    the marginal timer deliberately cancels out of the chip metrics: per
+    minibatch, one Python dispatch, one host->device transfer, and one
+    listener round-trip. Measures the same iterator through the unfused
+    per-minibatch path (``fused_steps=1``) and the fused K-step driver
+    (``fused_steps=None`` — the shipping default), and reports the ratio.
+
+    Config notes: per-minibatch overhead is CONSTANT per step while compute
+    scales with the batch, so the metric uses a small batch where the
+    quantity under test is visible above compute (at batch 512 the dispatch
+    slack is <1% of a step and the metric would measure conv throughput
+    again — bench_lenet already does that). A score-reading listener is
+    attached to both legs so the per-iteration score round-trip (one device
+    fetch per step unfused, one per block fused) is part of the timing.
+    Median of ``reps`` timed epochs per leg, all samples recorded."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.models import LeNet
+    from deeplearning4j_tpu.optimize.listeners import TrainingListener
+
+    class _ScoreReader(TrainingListener):
+        def iteration_done(self, model, iteration):
+            float(model.score_value)
+
+    rs = np.random.RandomState(1)
+    x = rs.randn(n_examples, 28, 28, 1).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rs.randint(0, 10, n_examples)]
+    iterator = ListDataSetIterator(DataSet(x, y), batch_size=batch)
+
+    def leg(fused_steps):
+        net = LeNet(num_labels=10).init()
+        net.set_listeners(_ScoreReader())
+        net.fit(iterator, epochs=1, fused_steps=fused_steps)  # compile warm
+        samples = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            net.fit(iterator, epochs=1, fused_steps=fused_steps)
+            samples.append(n_examples / (time.perf_counter() - t0))
+        return float(np.median(samples)), [round(s, 1) for s in samples]
+
+    unfused, unfused_samples = leg(1)
+    fused, fused_samples = leg(None)
+    return {
+        "fit_e2e_unfused_img_s": _sane("fit_e2e_img_s", unfused),
+        "fit_e2e_unfused_samples": unfused_samples,
+        "fit_e2e_img_s": _sane("fit_e2e_img_s", fused),
+        "fit_e2e_samples": fused_samples,
+        "fit_e2e_fused_speedup": fused / unfused,
+    }
+
+
 def bench_word2vec(n_sentences: int = 50000, epochs: int = 1):
     """SkipGram words/s on a synthetic 1M-word corpus, 30k vocab (BASELINE
     config #4; corpus sized so fixed host/dispatch overheads are amortised
@@ -436,6 +488,7 @@ def bench_doc2vec(n_docs: int = 4000, epochs: int = 1):
 # bug, and publishing it poisons every number beside it. Refuse instead.
 SANITY_CEILING = {
     "lenet_mnist_img_s": 1e8,
+    "fit_e2e_img_s": 1e8,
     "vgg16_bf16_img_s": 1e5,
     "textgen_lstm_tokens_s": 1e9,
     "transformer_lm_tokens_s": 1e9,
@@ -459,6 +512,9 @@ def _sane(name: str, value: float) -> float:
 # "unit" field when a sub-metric is run standalone
 METRIC_UNIT = {
     "lenet_mnist_img_s": "img/s",
+    "fit_e2e_img_s": "img/s",
+    "fit_e2e_unfused_img_s": "img/s",
+    "fit_e2e_fused_speedup": "x",
     "vgg16_bf16_img_s": "img/s",
     "textgen_lstm_tokens_s": "tokens/s",
     "transformer_lm_tokens_s": "tokens/s",
@@ -685,7 +741,7 @@ class _HeadlineSampler:
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     valid = ("all", "resnet50", "vgg16", "lenet", "lstm", "transformer",
-             "word2vec", "doc2vec", "attention")
+             "word2vec", "doc2vec", "attention", "fit_e2e")
     if which not in valid:
         sys.exit(f"Unknown model '{which}'; choose one of {valid}")
     # persistent XLA compile cache: repeated bench runs skip the
@@ -708,6 +764,9 @@ def main():
     if which in ("all", "lenet"):
         _sub_metric(extras, "lenet_mnist_img_s", bench_lenet)
         headline and headline.sample("post-lenet")
+    if which in ("all", "fit_e2e"):
+        _sub_metric(extras, "fit_e2e", bench_fit_e2e)
+        headline and headline.sample("post-fit-e2e")
     if which in ("all", "vgg16"):
         _sub_metric(extras, "vgg16_bf16_img_s", bench_vgg16, digits=2)
         if extras.get("vgg16_bf16_img_s"):

@@ -170,23 +170,11 @@ class DevicePrefetchIterator(DataSetIterator):
         return DataSet.on_device(*placed)
 
     def _iterate(self):
-        from collections import deque
+        from deeplearning4j_tpu.optimize.fused_fit import device_put_ahead
 
         it = (self.base._iterate() if isinstance(self.base, DataSetIterator)
               else iter(self.base))
-        buf: deque = deque()
-        try:
-            for _ in range(self.depth):
-                buf.append(self._put(next(it)))
-        except StopIteration:
-            pass
-        while buf:
-            nxt = buf.popleft()
-            try:
-                buf.append(self._put(next(it)))  # dispatch ahead, async
-            except StopIteration:
-                pass
-            yield nxt
+        return device_put_ahead(it, self.depth, self._put)
 
     def total_examples(self):
         return self.base.total_examples() \

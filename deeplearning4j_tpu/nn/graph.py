@@ -26,12 +26,7 @@ from deeplearning4j_tpu.nn.conf.graph_conf import (
 )
 from deeplearning4j_tpu.nn.conf.layers.misc import CenterLossOutputLayer
 from deeplearning4j_tpu.nn.conf.preprocessors import preprocessor_key
-from deeplearning4j_tpu.nn.regularization import (add_regularization_grads,
-                                                  penalty_value)
-from deeplearning4j_tpu.nn.gradient_normalization import (
-    apply_gradient_normalization,
-    layer_map_for,
-)
+from deeplearning4j_tpu.nn.regularization import penalty_value
 from deeplearning4j_tpu.nn.multilayer import _split_state
 
 
@@ -52,7 +47,11 @@ class ComputationGraph:
         self.iteration = 0
         self.epoch = 0
         self.listeners: list = []
-        self.score_value: float = float("nan")
+        # score_value contract: array-like scalar, never guaranteed to be a
+        # Python float — see MultiLayerNetwork (score() coerces)
+        self.score_value = float("nan")
+        self._base_key = None             # cached PRNGKey(seed), see _rng_base
+        self._base_key_seed = None
         self._step_cache: dict = {}
         self._output_cache: dict = {}
         self._rnn_state: Optional[dict] = None
@@ -228,45 +227,36 @@ class ComputationGraph:
             tree[name] = leaf
         return tree if any_override else None
 
+    def _rng_base(self):
+        """Cached base PRNG key (see MultiLayerNetwork._rng_base)."""
+        if self._base_key is None or self._base_key_seed != self.conf.seed:
+            self._base_key = jax.random.PRNGKey(self.conf.seed)
+            self._base_key_seed = self.conf.seed
+        return self._base_key
+
     def _make_step(self, with_carry: bool):
-        updater = self.conf.updater
-        lr_mults = self._lr_mult_tree()
-        conf = self.conf
-        center_outs = [name for name in conf.network_outputs
-                       if isinstance(conf.vertices[name], LayerVertex)
-                       and isinstance(conf.vertices[name].layer,
-                                      CenterLossOutputLayer)]
+        from deeplearning4j_tpu.optimize.fused_fit import build_step_core
+
+        # shared step body — also scanned by the fused K-step driver and
+        # ParallelWrapper's device round (see optimize/fused_fit.py)
+        core = build_step_core(self)
 
         def step(params, opt_state, state, rng, iteration, xs, ys, ims, lms,
                  carry):
-            def loss_fn(p):
-                return self._loss(p, state, xs, ys, ims, lms, train=True,
-                                  rng=rng, carry=carry if with_carry else None)
-
-            (loss, (new_states, new_carry, last_ins)), grads = \
-                jax.value_and_grad(loss_fn, has_aux=True)(params)
-            grads = add_regularization_grads(self, params, grads)
-            grads = apply_gradient_normalization(layer_map_for(self), grads)
-            if lr_mults is not None:
-                steps, opt_state2 = updater.step(grads, opt_state, iteration,
-                                                 lr_mults)
-            else:
-                steps, opt_state2 = updater.step(grads, opt_state, iteration)
-            new_params = jax.tree_util.tree_map(lambda p, s: p - s, params,
-                                                steps)
-            for name in center_outs:
-                j = conf.network_outputs.index(name)
-                y = ys[j] if isinstance(ys, (list, tuple)) else ys
-                new_states[name] = conf.vertices[name].layer.update_centers(
-                    state[name], last_ins[name], y)
-            return new_params, opt_state2, new_states, new_carry, loss
+            return core(params, opt_state, state, rng, iteration, xs, ys,
+                        ims, lms, carry if with_carry else None)
 
         # donated: do_step rebinds params/opt/state from the outputs
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
     def _get_step(self, key):
         if key not in self._step_cache:
-            self._step_cache[key] = self._make_step(with_carry=key[-1])
+            if key[0] == "fused":
+                from deeplearning4j_tpu.optimize.fused_fit import \
+                    build_fused_step
+                self._step_cache[key] = build_fused_step(self)
+            else:
+                self._step_cache[key] = self._make_step(with_carry=key[-1])
         return self._step_cache[key]
 
     def do_step(self, xs, ys, input_masks=None, label_masks=None, carry=None):
@@ -284,8 +274,7 @@ class ComputationGraph:
                ims is not None and any(m is not None for m in ims),
                lms is not None and any(m is not None for m in lms), with_carry)
         step = self._get_step(key)
-        rng = jax.random.fold_in(jax.random.PRNGKey(self.conf.seed),
-                                 self.iteration)
+        rng = jax.random.fold_in(self._rng_base(), self.iteration)
         (self.params, self.updater_state, self.state, new_carry, loss) = step(
             self.params, self.updater_state, self.state, rng,
             jnp.asarray(self.iteration, jnp.float32), xs, ys, ims, lms,
@@ -299,24 +288,43 @@ class ComputationGraph:
         return self.score_value, new_carry
 
     # ------------------------------------------------------------------- fit
-    def fit(self, data, labels=None, epochs: int = 1):
+    def fit(self, data, labels=None, epochs: int = 1, *,
+            fused_steps: Optional[int] = None, prefetch_depth: int = 2):
         """Train on a DataSet / MultiDataSet / iterator of either (reference:
-        ComputationGraph.fit :753-1030)."""
+        ComputationGraph.fit :753-1030).
+
+        Single-input single-output DataSet streams default to the fused
+        K-step fast path (see MultiLayerNetwork.fit and
+        optimize/fused_fit.py); ``fused_steps=1`` opts out. MultiDataSet
+        batches and TBPTT always take the per-minibatch path."""
         from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+        from deeplearning4j_tpu.optimize.fused_fit import (FusedFitDriver,
+                                                           resolve_fused_steps)
 
         if labels is not None:
             data = DataSet(np.asarray(data), np.asarray(labels))
+        K = resolve_fused_steps(self, fused_steps)
         if isinstance(data, (DataSet, MultiDataSet)):
+            if K > 1 and epochs > 1 and isinstance(data, DataSet):
+                # repeated single-batch fit: fuse the epochs loop (this path
+                # fires no epoch listeners, so semantics are unchanged)
+                FusedFitDriver(self, K, prefetch_depth).fit_stream(
+                    data for _ in range(epochs))
+                return self
             for _ in range(epochs):
                 self._fit_batch(data)
             return self
+        driver = (FusedFitDriver(self, K, prefetch_depth) if K > 1 else None)
         for _ in range(epochs):
             for listener in self.listeners:
                 listener.on_epoch_start(self)
             if hasattr(data, "reset"):
                 data.reset()
-            for ds in data:
-                self._fit_batch(ds)
+            if driver is not None:
+                driver.fit_stream(iter(data))
+            else:
+                for ds in data:
+                    self._fit_batch(ds)
             for listener in self.listeners:
                 listener.on_epoch_end(self)
             self.epoch += 1
@@ -378,6 +386,9 @@ class ComputationGraph:
     def score(self, ds=None, x=None, y=None) -> float:
         from deeplearning4j_tpu.datasets.dataset import MultiDataSet
 
+        if ds is None and x is None:
+            # coerce the device-side score_value to a host float on demand
+            return float(self.score_value)
         if isinstance(ds, MultiDataSet):
             x, y = ds.features, ds.labels
             im = (ds.features_masks if any(m is not None

@@ -30,10 +30,6 @@ from deeplearning4j_tpu.nn.conf.layers.misc import CenterLossOutputLayer
 from deeplearning4j_tpu.nn.conf.preprocessors import preprocessor_key
 from deeplearning4j_tpu.nn.regularization import (add_regularization_grads,
                                                   penalty_value)
-from deeplearning4j_tpu.nn.gradient_normalization import (
-    apply_gradient_normalization,
-    layer_map_for,
-)
 from deeplearning4j_tpu.utils.pytree import flatten_params, unflatten_params
 
 _RNN_KEYS = ("h", "c", "kcache", "vcache", "cache_pos")
@@ -62,7 +58,15 @@ class MultiLayerNetwork:
         self.iteration = 0
         self.epoch = 0
         self.listeners: list = []
-        self.score_value: float = float("nan")
+        # score_value CONTRACT: the most recent minibatch loss as an
+        # array-like scalar — a device array after do_step (float() would
+        # force a per-step sync and stall the dispatch pipeline), a numpy
+        # scalar after a fused-fit block, float("nan") before any step. It
+        # is NEVER guaranteed to be a Python float; coerce via score() (the
+        # no-argument form) or float().
+        self.score_value = float("nan")
+        self._base_key = None             # cached PRNGKey(seed), see _rng_base
+        self._base_key_seed = None
         self._step_cache: dict = {}
         self._output_cache: dict = {}
         self._rnn_state: Optional[dict] = None  # streaming rnnTimeStep state
@@ -212,34 +216,28 @@ class MultiLayerNetwork:
             tree[str(i)] = leaf
         return tree if any_override else None
 
+    def _rng_base(self):
+        """Cached base PRNG key — rebuilt only when conf.seed changes. The
+        per-step key is fold_in(base, iteration); reconstructing PRNGKey
+        (an XLA dispatch) every do_step was pure per-iteration overhead."""
+        if self._base_key is None or self._base_key_seed != self.conf.seed:
+            self._base_key = jax.random.PRNGKey(self.conf.seed)
+            self._base_key_seed = self.conf.seed
+        return self._base_key
+
     def _make_step(self, with_carry: bool):
-        updater = self.conf.updater
-        lr_mults = self._lr_mult_tree()
+        from deeplearning4j_tpu.optimize.fused_fit import build_step_core
+
+        # the step body (forward/loss/grad/regularization/normalization/
+        # updater/center-update) is the SHARED core also scanned by the
+        # fused K-step driver and ParallelWrapper's device round
+        core = build_step_core(self)
 
         def step(params, opt_state, state, rng, iteration, x, y, input_mask,
                  label_mask, carry):
-            def loss_fn(p):
-                return self._loss(p, state, x, y, input_mask, label_mask,
-                                  train=True, rng=rng,
-                                  carry=carry if with_carry else None)
-
-            (loss, (new_states, new_carry, last_in)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
-            grads = add_regularization_grads(self, params, grads)
-            grads = apply_gradient_normalization(layer_map_for(self), grads)
-            if lr_mults is not None:
-                steps, opt_state2 = updater.step(grads, opt_state, iteration,
-                                                 lr_mults)
-            else:
-                steps, opt_state2 = updater.step(grads, opt_state, iteration)
-            new_params = jax.tree_util.tree_map(lambda p, s: p - s, params, steps)
-            # non-gradient center update for center loss
-            out_idx = len(self.layers) - 1
-            out_layer = self.layers[out_idx]
-            if isinstance(out_layer, CenterLossOutputLayer):
-                new_states[str(out_idx)] = out_layer.update_centers(
-                    state[str(out_idx)], last_in, y)
-            return new_params, opt_state2, new_states, new_carry, loss
+            return core(params, opt_state, state, rng, iteration, x, y,
+                        input_mask, label_mask,
+                        carry if with_carry else None)
 
         # params/opt/state buffers are dead after the call (do_step rebinds
         # them from the outputs) — donation lets XLA update in place instead
@@ -249,7 +247,12 @@ class MultiLayerNetwork:
 
     def _get_step(self, key):
         if key not in self._step_cache:
-            self._step_cache[key] = self._make_step(with_carry=key[-1])
+            if key[0] == "fused":
+                from deeplearning4j_tpu.optimize.fused_fit import \
+                    build_fused_step
+                self._step_cache[key] = build_fused_step(self)
+            else:
+                self._step_cache[key] = self._make_step(with_carry=key[-1])
         return self._step_cache[key]
 
     def do_step(self, x, y, input_mask=None, label_mask=None, carry=None):
@@ -262,7 +265,7 @@ class MultiLayerNetwork:
         key = (x.shape, y.shape, input_mask is not None, label_mask is not None,
                with_carry)
         step = self._get_step(key)
-        rng = jax.random.fold_in(jax.random.PRNGKey(self.conf.seed), self.iteration)
+        rng = jax.random.fold_in(self._rng_base(), self.iteration)
         (self.params, self.updater_state, self.state, new_carry, loss) = step(
             self.params, self.updater_state, self.state, rng,
             jnp.asarray(self.iteration, jnp.float32), x, y, input_mask, label_mask,
@@ -276,24 +279,48 @@ class MultiLayerNetwork:
         return self.score_value, new_carry
 
     # ------------------------------------------------------------------ fit
-    def fit(self, data, labels=None, epochs: int = 1):
+    def fit(self, data, labels=None, epochs: int = 1, *,
+            fused_steps: Optional[int] = None, prefetch_depth: int = 2):
         """Train. ``data`` may be (features, labels) arrays, a DataSet, or a
-        DataSetIterator (reference: MultiLayerNetwork.fit :1047)."""
+        DataSetIterator (reference: MultiLayerNetwork.fit :1047).
+
+        The default fast path fuses ``fused_steps`` minibatches (default
+        ``optimize.fused_fit.DEFAULT_FUSED_STEPS``) into one jitted
+        ``lax.scan`` block fed by device-prefetched input — pass
+        ``fused_steps=1`` to opt out and run one jitted program per
+        minibatch. TBPTT always runs unfused. Listeners still fire per
+        iteration but scores materialize per block (one device fetch per
+        ``fused_steps`` iterations); listener hooks observe end-of-block
+        parameters."""
         from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.optimize.fused_fit import (FusedFitDriver,
+                                                           resolve_fused_steps)
 
         if labels is not None:
             data = DataSet(np.asarray(data), np.asarray(labels))
+        K = resolve_fused_steps(self, fused_steps)
         if isinstance(data, DataSet):
+            if K > 1 and epochs > 1:
+                # repeated single-batch fit: the epochs loop IS the stream —
+                # fuse it (the DataSet path fires no epoch listeners, so
+                # semantics are unchanged)
+                FusedFitDriver(self, K, prefetch_depth).fit_stream(
+                    data for _ in range(epochs))
+                return self
             for _ in range(epochs):
                 self._fit_batch(data)
             return self
+        driver = (FusedFitDriver(self, K, prefetch_depth) if K > 1 else None)
         for _ in range(epochs):
             for listener in self.listeners:
                 listener.on_epoch_start(self)
             if hasattr(data, "reset"):
                 data.reset()
-            for ds in data:
-                self._fit_batch(ds)
+            if driver is not None:
+                driver.fit_stream(iter(data))
+            else:
+                for ds in data:
+                    self._fit_batch(ds)
             for listener in self.listeners:
                 listener.on_epoch_end(self)
             self.epoch += 1
@@ -337,7 +364,12 @@ class MultiLayerNetwork:
         return self._output_cache[key](self.params, self.state, x, mask)
 
     def score(self, ds=None, x=None, y=None) -> float:
-        """Loss (incl. regularization) on a dataset (reference: computeGradientAndScore)."""
+        """Loss (incl. regularization) on a dataset, as a Python float
+        (reference: computeGradientAndScore). With NO arguments, coerces and
+        returns the last training minibatch's loss — the float view of the
+        ``score_value`` contract (score_value itself stays device-side)."""
+        if ds is None and x is None:
+            return float(self.score_value)
         if ds is not None:
             x, y = ds.features, ds.labels
             im, lm = ds.features_mask, ds.labels_mask

@@ -4,6 +4,14 @@ Reference: optimize/api/IterationListener.java + TrainingListener.java (hooks fi
 by the optimizer, e.g. ComputationGraph.java:1192-1235) and the impls under
 optimize/listeners/ (ScoreIterationListener, PerformanceListener, EvaluativeListener,
 CollectScoresIterationListener, TimeIterationListener, ModelSavingCallback).
+
+Block semantics under the fused fit path (optimize/fused_fit.py): ``fit``
+compiles K SGD steps into one device program, so scores materialize per
+BLOCK — one host fetch of the stacked loss array per K iterations.
+``iteration_done`` still fires once per iteration (with ``model.score_value``
+set to that iteration's score), but model parameters observed inside the
+hook are the END-OF-BLOCK parameters. Listeners that want the whole stacked
+score array at once override ``on_block_done``.
 """
 
 from __future__ import annotations
@@ -25,6 +33,14 @@ class TrainingListener:
         pass
 
     def on_epoch_end(self, model):
+        pass
+
+    def on_block_done(self, model, iterations: list, scores):
+        """Fired once per fused K-step block, BEFORE the per-iteration
+        ``iteration_done`` calls for that block. ``iterations`` is the list
+        of iteration numbers the block ran; ``scores`` the matching numpy
+        score array (one device fetch for the whole block). ``model``
+        carries end-of-block parameters."""
         pass
 
     def on_phase_timings(self, model, timings: dict):

@@ -24,6 +24,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from deeplearning4j_tpu.parallel.mesh import SHARD_MAP_VMA, shard_map_compat
+
 MODEL_AXIS = "model"
 DATA_AXIS = "data"
 
@@ -71,11 +73,18 @@ def tp_mlp_train_step(mesh: Mesh, activation, loss_fn, lr: float = 0.1):
 
         loss, grads = jax.value_and_grad(local_loss)(params)
         # The loss is computed (identically) on EVERY model-axis device, so
-        # the psum transpose hands each weight shard the cotangents of all
-        # n_model loss copies — scale by 1/n_model to recover the gradient
-        # of the single logical loss.
+        # leaves whose cotangents flow through the forward psum arrive
+        # n_model-times over-counted — scale by 1/n_model to recover the
+        # gradient of the single logical loss. Which leaves: under the
+        # VMA-tracking shard_map every leaf; under the legacy check_rep
+        # tracker only the MODEL_AXIS-sharded ones (it dedups the cotangents
+        # of replicated leaves like b2 itself; measured, jax 0.4.x).
         n_model = lax.psum(1, MODEL_AXIS)
-        grads = jax.tree_util.tree_map(lambda g: g / n_model, grads)
+        grads = {
+            k: g / n_model
+            if SHARD_MAP_VMA or MODEL_AXIS in param_specs[k] else g
+            for k, g in grads.items()
+        }
         # DP reduction: every leaf is averaged over the data axis. TP needs
         # no further gradient collective: each device owns its weight shard.
         grads = lax.pmean(grads, DATA_AXIS)
@@ -89,7 +98,7 @@ def tp_mlp_train_step(mesh: Mesh, activation, loss_fn, lr: float = 0.1):
     # mis-typed (replicated cotangents get re-summed) and sharded-weight
     # gradients come out wrong — VMA tracking inserts the correct
     # pbroadcast/psum pairing for the backward pass.
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         local_step, mesh=mesh,
         in_specs=(param_specs, x_spec, P(DATA_AXIS, None)),
         out_specs=(param_specs, P()))

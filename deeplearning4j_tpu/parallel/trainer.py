@@ -34,12 +34,18 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from deeplearning4j_tpu.nn.regularization import add_regularization_grads
-from deeplearning4j_tpu.nn.gradient_normalization import (
-    apply_gradient_normalization,
-    layer_map_for,
-)
+from deeplearning4j_tpu.optimize.fused_fit import (build_step_core,
+                                                   make_scan_body)
 from deeplearning4j_tpu.optimize.listeners import TrainingListener
+
+# jax >= 0.6 exposes shard_map at top level with check_vma; older releases
+# keep it in jax.experimental with the check_rep spelling
+try:
+    _shard_map = jax.shard_map
+    _SHARD_MAP_CHECK_KW = "check_vma"
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _SHARD_MAP_CHECK_KW = "check_rep"
 from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, data_mesh
 
 AVERAGING = "averaging"
@@ -78,22 +84,19 @@ class ParallelWrapper:
     # ------------------------------------------------------------------ build
     def _build_round(self, has_im: bool, has_lm: bool):
         net = self.net
-        updater = net.conf.updater
-        lr_mults = net._lr_mult_tree() if hasattr(net, "_lr_mult_tree") else None
-        layer_map = layer_map_for(net)
         pmean_grads = self.mode == SHARED_GRADIENTS
         avg_params = self.mode == AVERAGING
         average_updaters = self.average_updaters
-        # non-gradient center update for CenterLossOutputLayer heads (parity with
-        # MultiLayerNetwork._make_step's post-step update)
-        center_layer = None
-        center_key = None
-        layers = getattr(net, "layers", None)
-        if isinstance(layers, list) and layers:
-            from deeplearning4j_tpu.nn.conf.layers.misc import CenterLossOutputLayer
-            if isinstance(layers[-1], CenterLossOutputLayer):
-                center_layer = layers[-1]
-                center_key = str(len(layers) - 1)
+        # the shared step core (forward, reg grads, normalization, updater,
+        # center-loss update) — identical to the single-device fit paths; the
+        # pmean hook runs between regularization and normalization, so
+        # SHARED_GRADIENTS normalizes the GLOBAL gradient exactly as a single
+        # device would on the concatenated batch (the module's parity
+        # contract) while AVERAGING normalizes each worker's local step
+        core = build_step_core(
+            net,
+            grad_transform=((lambda g: lax.pmean(g, DATA_AXIS))
+                            if pmean_grads else None))
 
         def device_round(params, opt, state, rng, it0, xs, ys, ims, lms):
             """Runs on ONE device's shard: F local steps, then averaging.
@@ -102,38 +105,18 @@ class ParallelWrapper:
             """
             didx = lax.axis_index(DATA_AXIS)
 
-            def body(carry, inp):
-                params, opt, state, it = carry
-                x, y, im, lm = inp
-                step_rng = jax.random.fold_in(
-                    jax.random.fold_in(rng, it.astype(jnp.int32)), didx)
+            def sharded_core(params, opt_state, st, step_rng, it, x, y, im,
+                             lm, carry):
+                # the host stacks zero-filled placeholder masks for unmasked
+                # streams (one scan signature); drop them before the loss
+                return core(params, opt_state, st, step_rng, it, x, y,
+                            im if has_im else None,
+                            lm if has_lm else None, carry)
 
-                def loss_fn(p):
-                    return net._loss(p, state, x, y,
-                                     im if has_im else None,
-                                     lm if has_lm else None,
-                                     train=True, rng=step_rng)
-
-                (loss, (new_states, _, last_in)), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True)(params)
-                grads = add_regularization_grads(net, params, grads)
-                if pmean_grads:
-                    grads = lax.pmean(grads, DATA_AXIS)
-                # after the pmean: SHARED_GRADIENTS normalizes the global
-                # gradient exactly as a single device would on the
-                # concatenated batch (the module's parity contract);
-                # AVERAGING normalizes each worker's local step
-                grads = apply_gradient_normalization(layer_map, grads)
-                if lr_mults is not None:
-                    steps, opt = updater.step(grads, opt, it, lr_mults)
-                else:
-                    steps, opt = updater.step(grads, opt, it)
-                params = jax.tree_util.tree_map(lambda p, s: p - s, params, steps)
-                if center_layer is not None:
-                    new_states[center_key] = center_layer.update_centers(
-                        state[center_key], last_in, y)
-                return (params, opt, new_states, it + 1.0), loss
-
+            body = make_scan_body(
+                sharded_core,
+                rng_fn=lambda it: jax.random.fold_in(
+                    jax.random.fold_in(rng, it.astype(jnp.int32)), didx))
             (params, opt, state, _), losses = lax.scan(
                 body, (params, opt, state, it0), (xs, ys, ims, lms))
             if avg_params:
@@ -147,12 +130,12 @@ class ParallelWrapper:
             return params, opt, state, loss
 
         batch_spec = P(None, DATA_AXIS)
-        fn = jax.shard_map(
+        fn = _shard_map(
             device_round, mesh=self.mesh,
             in_specs=(P(), P(), P(), P(), P(),
                       batch_spec, batch_spec, batch_spec, batch_spec),
             out_specs=(P(), P(), P(), P()),
-            check_vma=False)
+            **{_SHARD_MAP_CHECK_KW: False})
         # params/opt/state are rebound from the round's outputs
         return jax.jit(fn, donate_argnums=(0, 1, 2))
 
@@ -245,7 +228,9 @@ class ParallelWrapper:
         key = (feats.shape, labs.shape, has_im, has_lm)
         rnd = self._get_round(key)
         t_dev0 = time.perf_counter()
-        rng = jax.random.fold_in(jax.random.PRNGKey(net.conf.seed), net.iteration)
+        base = (net._rng_base() if hasattr(net, "_rng_base")
+                else jax.random.PRNGKey(net.conf.seed))
+        rng = jax.random.fold_in(base, net.iteration)
         params, opt, state, loss = rnd(
             net.params, net.updater_state, net.state, rng,
             jnp.asarray(net.iteration, jnp.float32), feats, labs, ims, lms)

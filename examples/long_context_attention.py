@@ -22,7 +22,12 @@ import jax
 
 if SMOKE:  # hermetic: CPU with a virtual 8-device mesh for the SP demo
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:  # jax < 0.5: only the XLA_FLAGS spelling exists
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8")
 
 import numpy as np
 import jax.numpy as jnp

@@ -10,12 +10,76 @@ TPU plugin), so the platform must be forced via jax.config, not env vars — con
 updates take effect because no backend has been initialised yet when conftest runs.
 """
 
-import jax
+import os
+
+# must be set before the CPU backend initialises; harmless if the running
+# jax already understands jax_num_cpu_devices (the flag below then wins)
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:  # pre-0.4.34 jax: the XLA_FLAGS fallback above applies
+    pass
 jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+# A jitted train step compiled per minibatch (instead of per shape bucket)
+# turns every fit loop into a compile loop. The fused/unfused step builders
+# both route through Model._get_step, so counting cache misses per network
+# instance catches any reintroduced per-batch recompile: a leak compiles
+# once per iteration and blows well past this bound, while legitimate tests
+# (a few shape buckets + mask/carry combos) stay under it.
+MAX_STEP_COMPILES_PER_NET = 8
+
+
+@pytest.fixture(autouse=True)
+def _step_recompile_guard(request):
+    if request.node.get_closest_marker("allow_step_recompiles"):
+        yield
+        return
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    counts: dict = {}
+    patched = []
+
+    def instrument(cls):
+        orig = cls._get_step
+
+        def counted(self, key, _orig=orig):
+            if key not in self._step_cache:
+                counts[id(self)] = counts.get(id(self), 0) + 1
+            return _orig(self, key)
+
+        cls._get_step = counted
+        patched.append((cls, orig))
+
+    instrument(MultiLayerNetwork)
+    instrument(ComputationGraph)
+    try:
+        yield
+    finally:
+        for cls, orig in patched:
+            cls._get_step = orig
+    worst = max(counts.values(), default=0)
+    assert worst <= MAX_STEP_COMPILES_PER_NET, (
+        f"a single network compiled {worst} distinct train-step programs in "
+        f"one test (cap {MAX_STEP_COMPILES_PER_NET}) — a jitted step is "
+        "being allocated per iteration instead of per shape bucket; use the "
+        "bucketed fused-fit path or mark the test @pytest.mark."
+        "allow_step_recompiles if the shapes are genuinely diverse")
 
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running test")
+    config.addinivalue_line(
+        "markers",
+        "allow_step_recompiles: opt out of the per-test train-step "
+        "recompile-count guard")

@@ -383,6 +383,11 @@ class TestDerivedResume:
         with pytest.warns(UserWarning, match="derived resume point"):
             final = resumed.run(factory, epochs=2)
         assert final.iteration == base.iteration
+        # The crashing net trains through the (default) fused fit, whose
+        # compiled program matches the per-batch reference only to
+        # compile-level rounding (~1e-7); double-training a batch would diff
+        # at ~1e-3, so a tight-but-nonzero tolerance still discriminates the
+        # silent-retrain bug this test guards against.
         np.testing.assert_allclose(
             np.asarray(final.params_flat(), np.float32),
-            np.asarray(base.params_flat(), np.float32), rtol=0, atol=0)
+            np.asarray(base.params_flat(), np.float32), rtol=0, atol=5e-6)

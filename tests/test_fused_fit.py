@@ -1,0 +1,261 @@
+"""Fused multi-step fit driver tests (optimize/fused_fit.py).
+
+Covers the ISSUE-1 acceptance surface: fused-vs-unfused loss-trajectory and
+parameter equivalence (same seeds, K in {1, 4}), trailing-partial-batch
+correctness under shape bucketing, the one-program-per-ragged-epoch
+guarantee, the score_value contract, and the block-level listener semantics.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Adam
+from deeplearning4j_tpu.optimize.fused_fit import (
+    DEFAULT_FUSED_STEPS_CPU,
+    FusedFitDriver,
+    device_put_ahead,
+    resolve_fused_steps,
+)
+from deeplearning4j_tpu.optimize.listeners import (
+    CollectScoresIterationListener,
+    TrainingListener,
+)
+
+TOL = 1e-5
+
+
+def _mln(seed=12345):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(0.02))
+            .weight_init("xavier").activation("relu")
+            .list(DenseLayer(n_out=16), DenseLayer(n_out=16),
+                  OutputLayer(n_out=3, loss="mcxent", activation="softmax"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _graph(seed=12345):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(0.02))
+            .weight_init("xavier").activation("relu")
+            .graph_builder().add_inputs("in")
+            .add_layer("d1", DenseLayer(n_out=16), "in")
+            .add_layer("out",
+                       OutputLayer(n_out=3, loss="mcxent", activation="softmax"),
+                       "d1")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4)).build())
+    return ComputationGraph(conf).init()
+
+
+def _iris_like(n, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, n)]
+    return DataSet(x, y)
+
+
+def _max_param_diff(a, b):
+    return max(float(jnp.max(jnp.abs(x - y))) for x, y in
+               zip(jax.tree_util.tree_leaves(a.params),
+                   jax.tree_util.tree_leaves(b.params)))
+
+
+# ------------------------------------------------------------- equivalence
+class TestFusedEquivalence:
+    @pytest.mark.parametrize("k", [1, 4])
+    def test_fused_matches_unfused_mln(self, k):
+        """Same seeds: K-fused training equals the per-minibatch path, both
+        in the per-iteration score trajectory and the final parameters."""
+        it = ListDataSetIterator(_iris_like(128), batch_size=32)
+        ref, fus = _mln(), _mln()
+        ref_scores = CollectScoresIterationListener()
+        fus_scores = CollectScoresIterationListener()
+        ref.set_listeners(ref_scores)
+        fus.set_listeners(fus_scores)
+        ref.fit(it, epochs=2, fused_steps=1)
+        fus.fit(it, epochs=2, fused_steps=k)
+        assert fus.iteration == ref.iteration == 8
+        assert _max_param_diff(ref, fus) <= TOL
+        ref_traj = [float(s) for _, s in ref_scores.scores]
+        fus_traj = [float(s) for _, s in fus_scores.scores]
+        assert [i for i, _ in ref_scores.scores] == [i for i, _ in fus_scores.scores]
+        np.testing.assert_allclose(fus_traj, ref_traj, atol=TOL)
+
+    def test_fused_matches_unfused_graph(self):
+        it = ListDataSetIterator(_iris_like(128), batch_size=32)
+        ref, fus = _graph(), _graph()
+        ref.fit(it, epochs=2, fused_steps=1)
+        fus.fit(it, epochs=2, fused_steps=4)
+        assert fus.iteration == ref.iteration == 8
+        assert _max_param_diff(ref, fus) <= TOL
+        assert abs(ref.score() - fus.score()) <= TOL
+
+    def test_tail_group_runs_unfused(self):
+        """A stream whose length is not a multiple of K: the trailing group
+        of fewer than K microbatches takes the per-minibatch path, and the
+        result still matches the unfused reference exactly."""
+        it = ListDataSetIterator(_iris_like(192), batch_size=32)  # 6 batches
+        ref, fus = _mln(), _mln()
+        ref.fit(it, epochs=1, fused_steps=1)
+        fus.fit(it, epochs=1, fused_steps=4)  # 1 block + 2-batch tail
+        assert fus.iteration == ref.iteration == 6
+        assert _max_param_diff(ref, fus) <= TOL
+        fused_keys = [kk for kk in fus._step_cache if kk[0] == "fused"]
+        unfused_keys = [kk for kk in fus._step_cache if kk[0] != "fused"]
+        assert len(fused_keys) == 1 and len(unfused_keys) == 1
+
+
+# --------------------------------------------------- bucketing / recompiles
+class TestShapeBucketing:
+    def test_trailing_partial_batch_correctness(self):
+        """118 examples at batch 32 -> 32,32,32,22: the undersized batch is
+        padded to the bucket with zeroed label-mask rows, and training
+        matches the unfused path (which sees the raw 22-row batch)."""
+        it = ListDataSetIterator(_iris_like(118), batch_size=32)
+        ref, fus = _mln(), _mln()
+        ref.fit(it, epochs=3, fused_steps=1)
+        fus.fit(it, epochs=3, fused_steps=4)
+        assert fus.iteration == ref.iteration == 12
+        assert _max_param_diff(ref, fus) <= TOL
+
+    def test_ragged_epoch_single_program(self):
+        """The recompile-count guarantee: a ragged-batch epoch compiles ONE
+        fused program — the padded tail batch reuses the full-block key."""
+        it = ListDataSetIterator(_iris_like(118), batch_size=32)
+        net = _mln()
+        net.fit(it, epochs=3, fused_steps=4)
+        assert len(net._step_cache) == 1
+        (key,) = net._step_cache
+        assert key[0] == "fused" and key[1] == 4
+
+    def test_masked_stream_buckets(self):
+        """Streams that already carry a labels_mask bucket too (the pad rows
+        extend the existing mask with zeros)."""
+        rs = np.random.RandomState(3)
+        n = 80  # batch 32 -> 32,32,16
+        x = rs.randn(n, 4).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, n)]
+        lm = np.ones(n, np.float32)
+        lm[::7] = 0.0
+        ds = DataSet(x, y, None, lm)
+        ref, fus = _mln(), _mln()
+        ref.fit(ListDataSetIterator(ds, batch_size=32), epochs=3, fused_steps=1)
+        fus.fit(ListDataSetIterator(ds, batch_size=32), epochs=3, fused_steps=3)
+        assert fus.iteration == ref.iteration == 9
+        assert _max_param_diff(ref, fus) <= TOL
+        assert len([k for k in fus._step_cache if k[0] == "fused"]) == 1
+
+
+# ------------------------------------------------------- score_value contract
+class TestScoreValueContract:
+    def test_score_value_stays_device_side(self):
+        """score_value holds the device scalar after training (no per-step
+        host sync); score() with no arguments coerces it to a float."""
+        net = _mln()
+        assert isinstance(net.score(), float) and np.isnan(net.score())
+        net.fit(ListDataSetIterator(_iris_like(64), batch_size=32),
+                epochs=1, fused_steps=2)
+        assert isinstance(net.score_value, jax.Array)
+        s = net.score()
+        assert isinstance(s, float) and np.isfinite(s)
+
+    def test_score_no_arg_graph(self):
+        net = _graph()
+        net.fit(ListDataSetIterator(_iris_like(64), batch_size=32),
+                epochs=1, fused_steps=2)
+        s = net.score()
+        assert isinstance(s, float) and np.isfinite(s)
+
+    def test_listener_path_scores_are_host_values(self):
+        """With listeners attached the block's stacked losses come back in
+        ONE device fetch; iteration_done then observes host-side scores."""
+        net = _mln()
+        seen = []
+
+        class Probe(TrainingListener):
+            def iteration_done(self, model, iteration):
+                seen.append((iteration, model.score_value))
+
+        net.set_listeners(Probe())
+        net.fit(ListDataSetIterator(_iris_like(128), batch_size=32),
+                epochs=1, fused_steps=4)
+        assert [i for i, _ in seen] == [1, 2, 3, 4]
+        assert all(isinstance(s, np.floating) for _, s in seen)
+
+
+# ----------------------------------------------------------- block listeners
+class TestBlockListeners:
+    def test_on_block_done_fires_once_per_block(self):
+        net = _mln()
+        blocks = []
+        iters = []
+
+        class Probe(TrainingListener):
+            def on_block_done(self, model, iterations, scores):
+                blocks.append((list(iterations), np.asarray(scores)))
+
+            def iteration_done(self, model, iteration):
+                iters.append(iteration)
+
+        net.set_listeners(Probe())
+        net.fit(ListDataSetIterator(_iris_like(256), batch_size=32),
+                epochs=1, fused_steps=4)  # 8 batches -> 2 full blocks
+        assert len(blocks) == 2
+        assert blocks[0][0] == [1, 2, 3, 4] and blocks[1][0] == [5, 6, 7, 8]
+        assert all(s.shape == (4,) for _, s in blocks)
+        # per-iteration hooks still fire once per iteration, after the block
+        assert iters == list(range(1, 9))
+
+
+# ------------------------------------------------------------- driver bits
+class TestDriverPlumbing:
+    def test_fused_steps_validation(self):
+        net = _mln()
+        with pytest.raises(ValueError):
+            net.fit(_iris_like(32), fused_steps=0)
+        with pytest.raises(ValueError):
+            FusedFitDriver(net, 0)
+
+    def test_cpu_default_fused_steps(self):
+        assert jax.default_backend() == "cpu"
+        assert resolve_fused_steps(_mln(), None) == DEFAULT_FUSED_STEPS_CPU
+
+    def test_device_put_ahead_order_and_depth(self):
+        placed = []
+        out = list(device_put_ahead(range(7), 3, lambda v: placed.append(v) or v))
+        assert out == list(range(7)) and placed == out
+        with pytest.raises(ValueError):
+            list(device_put_ahead(range(3), 0, lambda v: v))
+
+
+# ------------------------------------------------------------------ e2e perf
+@pytest.mark.slow
+def test_fit_e2e_fused_not_slower():
+    """End-to-end fit() wall clock (dispatch + transfer + listener round-trip
+    included): the fused path must not regress the per-minibatch path. The
+    headline ratio lives in bench.py's fit_e2e sub-metric; this guard uses a
+    loose floor because single-core CI boxes time with +/-15% noise."""
+    data = _iris_like(512)
+
+    def run(k):
+        it = ListDataSetIterator(data, batch_size=8)
+        net = _mln()
+        net.fit(it, epochs=1, fused_steps=k)  # warm both programs
+        t0 = time.perf_counter()
+        net.fit(it, epochs=4, fused_steps=k)
+        float(net.score())
+        return time.perf_counter() - t0
+
+    unfused, fused = run(1), run(2)
+    assert fused <= unfused * 1.25, (
+        f"fused e2e {fused:.3f}s vs unfused {unfused:.3f}s")

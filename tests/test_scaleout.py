@@ -123,7 +123,10 @@ class TestSparkFacade:
                    for b in _batches(8, 8)]
         master = ParameterAveragingTrainingMaster(workers=8)
         facade = SparkDl4jMultiLayer(net, master)
-        facade.fit(batches, epochs=10)
+        # 20 epochs: the trajectory crosses 0.5 around epoch 14 and reaches
+        # ~0.6 by 20, so the bar has margin against compile-level rounding
+        # shifts in the averaged step (10 epochs sat exactly at the bar).
+        facade.fit(batches, epochs=20)
         ev = facade.evaluate(ListDataSetIterator(batches, batch_size=8))
         assert ev.accuracy() > 0.5
 
