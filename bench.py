@@ -380,6 +380,96 @@ def bench_fit_e2e(batch: int = 1, n_examples: int = 96, reps: int = 5):
     }
 
 
+def bench_eval_e2e(batch: int = 1, n_examples: int = 96, reps: int = 5):
+    """LeNet-MNIST ``evaluate()`` wall clock, END TO END — the eval twin of
+    bench_fit_e2e. The per-batch path pays, per minibatch, one Python
+    dispatch, one host->device transfer, one FULL logit fetch back to host,
+    and a numpy confusion build; the fused path (the shipping default)
+    scans K batches per dispatch, scatter-adds into a device accumulator,
+    and fetches ONE [C, C] count matrix per epoch. Same small-batch
+    rationale as fit_e2e: the overheads under test are constant per step,
+    so a big batch would bury them under conv throughput (bench_lenet's
+    job). Median of ``reps`` timed epochs per leg, all samples recorded."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.models import LeNet
+
+    rs = np.random.RandomState(2)
+    x = rs.randn(n_examples, 28, 28, 1).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rs.randint(0, 10, n_examples)]
+    iterator = ListDataSetIterator(DataSet(x, y), batch_size=batch)
+    net = LeNet(num_labels=10).init()
+
+    def leg(fused):
+        iterator.reset()
+        net.evaluate(iterator, fused=fused)  # compile warm
+        samples = []
+        for _ in range(reps):
+            iterator.reset()
+            t0 = time.perf_counter()
+            net.evaluate(iterator, fused=fused)
+            samples.append(n_examples / (time.perf_counter() - t0))
+        return float(np.median(samples)), [round(s, 1) for s in samples]
+
+    unfused, unfused_samples = leg(False)
+    fused, fused_samples = leg(True)
+    return {
+        "eval_e2e_unfused_img_s": _sane("eval_e2e_img_s", unfused),
+        "eval_e2e_unfused_samples": unfused_samples,
+        "eval_e2e_img_s": _sane("eval_e2e_img_s", fused),
+        "eval_e2e_samples": fused_samples,
+        "eval_e2e_fused_speedup": fused / unfused,
+    }
+
+
+def bench_inference_serve(n_requests: int = 256, max_batch: int = 64,
+                          max_wait_ms: float = 2.0):
+    """Coalescing inference server latency/throughput: ``n_requests``
+    single-image LeNet requests pushed through ``submit()`` as fast as the
+    host can produce them (the serving worst case — every request is 1 row,
+    so ALL batching is the coalescer's doing). Reports requests/s plus p50
+    and p99 request latency (submit -> future resolution, measured by a
+    done-callback timestamp) and the dispatch count the coalescer needed."""
+    from deeplearning4j_tpu.models import LeNet
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+    rs = np.random.RandomState(3)
+    xs = rs.randn(n_requests, 1, 28, 28, 1).astype(np.float32)
+    net = LeNet(num_labels=10).init()
+    with ParallelInference(net, max_batch=max_batch,
+                           max_wait_ms=max_wait_ms) as inf:
+        inf.submit(xs[0]).result(timeout=120)  # compile warm (1-row bucket)
+        inf.output(xs[:max_batch, 0])          # warm the full-batch bucket
+        base = inf.dispatch_count
+        done_at = [None] * n_requests
+        t_submit = [None] * n_requests
+
+        def make_cb(i):
+            def cb(_fut):
+                done_at[i] = time.perf_counter()
+            return cb
+
+        t0 = time.perf_counter()
+        futs = []
+        for i in range(n_requests):
+            t_submit[i] = time.perf_counter()
+            f = inf.submit(xs[i])
+            f.add_done_callback(make_cb(i))
+            futs.append(f)
+        for f in futs:
+            f.result(timeout=120)
+        total = time.perf_counter() - t0
+        dispatches = inf.dispatch_count - base
+    lat_ms = sorted((d - s) * 1e3 for d, s in zip(done_at, t_submit))
+    return {
+        "inference_serve_req_s": _sane("inference_serve_req_s",
+                                       n_requests / total),
+        "inference_serve_p50_ms": lat_ms[len(lat_ms) // 2],
+        "inference_serve_p99_ms": lat_ms[int(len(lat_ms) * 0.99)],
+        "inference_serve_dispatches": float(dispatches),
+    }
+
+
 def bench_word2vec(n_sentences: int = 50000, epochs: int = 1):
     """SkipGram words/s on a synthetic 1M-word corpus, 30k vocab (BASELINE
     config #4; corpus sized so fixed host/dispatch overheads are amortised
@@ -489,6 +579,8 @@ def bench_doc2vec(n_docs: int = 4000, epochs: int = 1):
 SANITY_CEILING = {
     "lenet_mnist_img_s": 1e8,
     "fit_e2e_img_s": 1e8,
+    "eval_e2e_img_s": 1e8,
+    "inference_serve_req_s": 1e8,
     "vgg16_bf16_img_s": 1e5,
     "textgen_lstm_tokens_s": 1e9,
     "transformer_lm_tokens_s": 1e9,
@@ -515,6 +607,13 @@ METRIC_UNIT = {
     "fit_e2e_img_s": "img/s",
     "fit_e2e_unfused_img_s": "img/s",
     "fit_e2e_fused_speedup": "x",
+    "eval_e2e_img_s": "img/s",
+    "eval_e2e_unfused_img_s": "img/s",
+    "eval_e2e_fused_speedup": "x",
+    "inference_serve_req_s": "req/s",
+    "inference_serve_p50_ms": "ms",
+    "inference_serve_p99_ms": "ms",
+    "inference_serve_dispatches": "",
     "vgg16_bf16_img_s": "img/s",
     "textgen_lstm_tokens_s": "tokens/s",
     "transformer_lm_tokens_s": "tokens/s",
@@ -741,7 +840,8 @@ class _HeadlineSampler:
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     valid = ("all", "resnet50", "vgg16", "lenet", "lstm", "transformer",
-             "word2vec", "doc2vec", "attention", "fit_e2e")
+             "word2vec", "doc2vec", "attention", "fit_e2e", "eval_e2e",
+             "inference_serve")
     if which not in valid:
         sys.exit(f"Unknown model '{which}'; choose one of {valid}")
     # persistent XLA compile cache: repeated bench runs skip the
@@ -767,6 +867,12 @@ def main():
     if which in ("all", "fit_e2e"):
         _sub_metric(extras, "fit_e2e", bench_fit_e2e)
         headline and headline.sample("post-fit-e2e")
+    if which in ("all", "eval_e2e"):
+        _sub_metric(extras, "eval_e2e", bench_eval_e2e)
+        headline and headline.sample("post-eval-e2e")
+    if which in ("all", "inference_serve"):
+        _sub_metric(extras, "inference_serve", bench_inference_serve)
+        headline and headline.sample("post-inference-serve")
     if which in ("all", "vgg16"):
         _sub_metric(extras, "vgg16_bf16_img_s", bench_vgg16, digits=2)
         if extras.get("vgg16_bf16_img_s"):

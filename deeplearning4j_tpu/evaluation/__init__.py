@@ -5,6 +5,7 @@ RegressionEvaluation.java:32, ROC.java:53, EvaluationBinary, curves/.
 """
 
 from deeplearning4j_tpu.evaluation.classification import Evaluation
+from deeplearning4j_tpu.evaluation.fused_eval import FusedEvalDriver
 from deeplearning4j_tpu.evaluation.curves import (Histogram,
                                                   PrecisionRecallCurve,
                                                   RocCurve)

@@ -48,9 +48,17 @@ class Evaluation:
         pred_idx = np.argmax(predictions, axis=-1)
         np.add.at(self.confusion, (true_idx, pred_idx), 1)
         if self.top_n > 1:
-            n = min(self.top_n, predictions.shape[-1])
-            top = np.argpartition(predictions, -n, axis=-1)[..., -n:]
-            self.top_n_correct += int((top == true_idx[..., None]).any(-1).sum())
+            num_classes = predictions.shape[-1]
+            if self.top_n >= num_classes:
+                # top-N over all classes always contains the true class:
+                # every example counts as correct (and argpartition's kth
+                # would be out of range anyway)
+                self.top_n_correct += int(true_idx.size)
+            else:
+                n = self.top_n
+                top = np.argpartition(predictions, -n, axis=-1)[..., -n:]
+                self.top_n_correct += int(
+                    (top == true_idx[..., None]).any(-1).sum())
             self.top_n_total += int(true_idx.size)
         return self
 
@@ -87,6 +95,8 @@ class Evaluation:
     def precision(self, cls: Optional[int] = None) -> float:
         tp, fp, _ = self._counts()
         if cls is not None:
+            if self.confusion is None:
+                return 0.0  # zero state: like the aggregate metrics
             d = tp[cls] + fp[cls]
             return float(tp[cls] / d) if d else 0.0
         valid = (tp + fp) > 0
@@ -97,6 +107,8 @@ class Evaluation:
     def recall(self, cls: Optional[int] = None) -> float:
         tp, _, fn = self._counts()
         if cls is not None:
+            if self.confusion is None:
+                return 0.0  # zero state: like the aggregate metrics
             d = tp[cls] + fn[cls]
             return float(tp[cls] / d) if d else 0.0
         valid = (tp + fn) > 0
@@ -118,6 +130,8 @@ class Evaluation:
                 if self.top_n_total else 0.0)
 
     def false_positive_rate(self, cls: int) -> float:
+        if self.confusion is None:
+            return 0.0  # zero state: like the aggregate metrics
         cm = self.confusion
         tp, fp, fn = self._counts()
         tn = cm.sum() - tp[cls] - fp[cls] - fn[cls]

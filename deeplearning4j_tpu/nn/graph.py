@@ -28,6 +28,8 @@ from deeplearning4j_tpu.nn.conf.layers.misc import CenterLossOutputLayer
 from deeplearning4j_tpu.nn.conf.preprocessors import preprocessor_key
 from deeplearning4j_tpu.nn.regularization import penalty_value
 from deeplearning4j_tpu.nn.multilayer import _split_state
+from deeplearning4j_tpu.optimize.bucketing import (BoundedCache, bucket_rows,
+                                                   pad_rows)
 
 
 def _as_list(x):
@@ -53,7 +55,9 @@ class ComputationGraph:
         self._base_key = None             # cached PRNGKey(seed), see _rng_base
         self._base_key_seed = None
         self._step_cache: dict = {}
-        self._output_cache: dict = {}
+        # inference/eval program cache: LRU-bounded, batch dim bucketed —
+        # see optimize/bucketing.py
+        self._output_cache = BoundedCache()
         self._rnn_state: Optional[dict] = None
         self._stream_pos = 0              # tokens consumed this stream
         self._stream_capacity = None      # min attention max_cache, if any
@@ -366,21 +370,39 @@ class ComputationGraph:
             carry = jax.tree_util.tree_map(jax.lax.stop_gradient, carry)
 
     # -------------------------------------------------------------- inference
+    def _get_output(self, key, build):
+        """Bounded cache for the inference/eval program family (forward,
+        rnn-stream, fused-eval) — see MultiLayerNetwork._get_output."""
+        if key not in self._output_cache:
+            self._output_cache[key] = build()
+        return self._output_cache[key]
+
     def output(self, *inputs, train: bool = False, masks=None):
         """Output-vertex activations; single output returns the bare array
-        (reference: ComputationGraph.output)."""
+        (reference: ComputationGraph.output). The shared batch dim is
+        bucketed to the next power of two (see optimize/bucketing.py) and
+        the padding stripped from every output."""
         xs = [jnp.asarray(a) for a in inputs]
         ms = ([None if m is None else jnp.asarray(m) for m in _as_list(masks)]
               if masks is not None else [None] * len(xs))
+        n = xs[0].shape[0]
+        B = bucket_rows(n)
+        if B != n:
+            xs = [pad_rows(a, B) for a in xs]
+            ms = [None if m is None else pad_rows(m, B) for m in ms]
         key = (tuple(a.shape for a in xs), train,
                tuple(m is not None for m in ms))
-        if key not in self._output_cache:
+
+        def build():
             def fwd(params, state, xs, ms):
                 outs, _, _, _, _ = self._forward(params, state, xs, ms,
                                                  train=train, rng=None)
                 return outs
-            self._output_cache[key] = jax.jit(fwd)
-        outs = self._output_cache[key](self.params, self.state, xs, ms)
+            return jax.jit(fwd)
+
+        outs = self._get_output(key, build)(self.params, self.state, xs, ms)
+        if B != n:
+            outs = [o[:n] for o in outs]
         return outs[0] if len(outs) == 1 else outs
 
     def score(self, ds=None, x=None, y=None) -> float:
@@ -411,19 +433,28 @@ class ComputationGraph:
             train=False, rng=None)
         return float(loss)
 
-    def evaluate(self, data, labels=None):
+    def evaluate(self, data, labels=None, *, top_n: int = 1, fused=None,
+                 eval_batches: Optional[int] = None, prefetch_depth: int = 2):
         """Single-output classification evaluation (reference:
-        ComputationGraph.evaluate)."""
+        ComputationGraph.evaluate). Defaults to the device-resident fused
+        evaluator (evaluation/fused_eval.py — K batches per dispatch, one
+        small fetch per call); pass ``fused=False`` for the per-batch
+        ``output()`` + host numpy path."""
         from deeplearning4j_tpu.datasets.dataset import DataSet
         from deeplearning4j_tpu.evaluation.classification import Evaluation
 
-        ev = Evaluation()
+        ev = Evaluation(top_n=top_n)
         if labels is not None:
             data = [DataSet(np.asarray(data), np.asarray(labels))]
         elif isinstance(data, DataSet):
             data = [data]
         elif hasattr(data, "reset"):
             data.reset()
+        if fused is None or fused:
+            from deeplearning4j_tpu.evaluation.fused_eval import \
+                FusedEvalDriver
+            return FusedEvalDriver(self, eval_batches,
+                                   prefetch_depth).evaluate(data, ev)
         for ds in data:
             out = self.output(ds.features, masks=ds.features_mask)
             ev.eval(ds.labels, np.asarray(out), mask=ds.labels_mask)
@@ -485,15 +516,17 @@ class ComputationGraph:
         # tunnel for a 4-block transformer — ~100 round-trips per step
         key = ("rnn_stream", tuple(a.shape for a in xs),
                jax.tree_util.tree_structure(carry))
-        if key not in self._output_cache:
+
+        def build():
             def fwd(params, state, xs, carry):
                 outs, _, new_carry, _, _ = self._forward(
                     params, state, xs, [None] * len(xs), train=False,
                     rng=None, carry=carry)
                 return outs, new_carry
-            self._output_cache[key] = jax.jit(fwd)
-        outs, new_carry = self._output_cache[key](self.params, self.state,
-                                                  xs, carry)
+            return jax.jit(fwd)
+
+        outs, new_carry = self._get_output(key, build)(self.params,
+                                                       self.state, xs, carry)
         self._rnn_state = new_carry
         outs = [o[:, 0] if squeeze and o.ndim == 3 else o for o in outs]
         return outs[0] if len(outs) == 1 else outs

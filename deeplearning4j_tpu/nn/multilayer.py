@@ -30,6 +30,8 @@ from deeplearning4j_tpu.nn.conf.layers.misc import CenterLossOutputLayer
 from deeplearning4j_tpu.nn.conf.preprocessors import preprocessor_key
 from deeplearning4j_tpu.nn.regularization import (add_regularization_grads,
                                                   penalty_value)
+from deeplearning4j_tpu.optimize.bucketing import (BoundedCache, bucket_rows,
+                                                   pad_rows)
 from deeplearning4j_tpu.utils.pytree import flatten_params, unflatten_params
 
 _RNN_KEYS = ("h", "c", "kcache", "vcache", "cache_pos")
@@ -68,7 +70,10 @@ class MultiLayerNetwork:
         self._base_key = None             # cached PRNGKey(seed), see _rng_base
         self._base_key_seed = None
         self._step_cache: dict = {}
-        self._output_cache: dict = {}
+        # inference/eval program cache: LRU-bounded, batch dim bucketed —
+        # see optimize/bucketing.py (a serving workload with arbitrary
+        # request sizes must not compile and hold a program per size)
+        self._output_cache = BoundedCache()
         self._rnn_state: Optional[dict] = None  # streaming rnnTimeStep state
         self._stream_pos = 0              # tokens consumed this stream
         self._stream_capacity = None      # min attention max_cache, if any
@@ -348,20 +353,41 @@ class MultiLayerNetwork:
             carry = jax.tree_util.tree_map(jax.lax.stop_gradient, carry)
 
     # ------------------------------------------------------------- inference
+    def _get_output(self, key, build):
+        """Bounded cache for the inference/eval program family (forward,
+        rnn-stream, fused-eval). One hook point, so the test suite's
+        recompile guard can count cache misses per network instance."""
+        if key not in self._output_cache:
+            self._output_cache[key] = build()
+        return self._output_cache[key]
+
     def output(self, x, train: bool = False, mask=None):
         """Final-layer activations (reference: MultiLayerNetwork.output :1717,
         incl. the mask-array overload — masks flow through the layers so e.g.
-        LastTimeStep / masked global pooling are correct for padded batches)."""
+        LastTimeStep / masked global pooling are correct for padded batches).
+
+        The batch dim is BUCKETED (padded to the next power of two by
+        replicating the last row, stripped from the result) so the jit cache
+        holds O(log max_batch) programs instead of one per request size."""
         x = jnp.asarray(x)
         mask = jnp.asarray(mask) if mask is not None else None
+        n = x.shape[0]
+        B = bucket_rows(n)
+        if B != n:
+            x = pad_rows(x, B)
+            if mask is not None:
+                mask = pad_rows(mask, B)
         key = (x.shape, train, mask is not None)
-        if key not in self._output_cache:
+
+        def build():
             def fwd(params, state, xx, mm):
-                out, _, _, _ = self._forward(params, state, xx, mm, train=train,
-                                             rng=None)
+                out, _, _, _ = self._forward(params, state, xx, mm,
+                                             train=train, rng=None)
                 return out
-            self._output_cache[key] = jax.jit(fwd)
-        return self._output_cache[key](self.params, self.state, x, mask)
+            return jax.jit(fwd)
+
+        out = self._get_output(key, build)(self.params, self.state, x, mask)
+        return out if B == n else out[:n]
 
     def score(self, ds=None, x=None, y=None) -> float:
         """Loss (incl. regularization) on a dataset, as a Python float
@@ -381,18 +407,31 @@ class MultiLayerNetwork:
                              train=False, rng=None)
         return float(loss)
 
-    def evaluate(self, data, labels=None):
-        """Classification evaluation (reference: MultiLayerNetwork.evaluate)."""
+    def evaluate(self, data, labels=None, *, top_n: int = 1, fused=None,
+                 eval_batches: Optional[int] = None, prefetch_depth: int = 2):
+        """Classification evaluation (reference: MultiLayerNetwork.evaluate).
+
+        The default fast path is the device-resident fused evaluator
+        (evaluation/fused_eval.py): forward + argmax + masked scatter-add
+        into a donated device accumulator, ``eval_batches`` batches per
+        dispatch, ONE small fetch per call instead of per-batch logit
+        transfers. Pass ``fused=False`` to opt out (per-batch ``output()``
+        + host numpy counting)."""
         from deeplearning4j_tpu.evaluation.classification import Evaluation
         from deeplearning4j_tpu.datasets.dataset import DataSet
 
-        ev = Evaluation()
+        ev = Evaluation(top_n=top_n)
         if labels is not None:
             data = [DataSet(np.asarray(data), np.asarray(labels))]
         elif isinstance(data, DataSet):
             data = [data]
         elif hasattr(data, "reset"):
             data.reset()
+        if fused is None or fused:
+            from deeplearning4j_tpu.evaluation.fused_eval import \
+                FusedEvalDriver
+            return FusedEvalDriver(self, eval_batches,
+                                   prefetch_depth).evaluate(data, ev)
         for ds in data:
             out = self.output(ds.features, mask=ds.features_mask)
             ev.eval(ds.labels, np.asarray(out), mask=ds.labels_mask)
@@ -432,15 +471,17 @@ class MultiLayerNetwork:
         # jitted per (shape, carry structure) — see ComputationGraph
         # .rnn_time_step: eager per-op dispatch dominates streaming cost
         key = ("rnn_stream", x.shape, jax.tree_util.tree_structure(carry))
-        if key not in self._output_cache:
+
+        def build():
             def fwd(params, state, x, carry):
                 out, _, new_carry, _ = self._forward(
                     params, state, x, None, train=False, rng=None,
                     carry=carry)
                 return out, new_carry
-            self._output_cache[key] = jax.jit(fwd)
-        out, new_carry = self._output_cache[key](self.params, self.state,
-                                                 x, carry)
+            return jax.jit(fwd)
+
+        out, new_carry = self._get_output(key, build)(self.params, self.state,
+                                                      x, carry)
         self._rnn_state = new_carry
         return out[:, 0] if squeeze and out.ndim == 3 else out
 

@@ -1,0 +1,83 @@
+"""Shared shape-bucketing utilities for the jit-program caches.
+
+Every jitted program in the stack is cached per input *shape signature*
+(train steps in ``_step_cache``, inference/eval programs in
+``_output_cache``, sharded forwards in ``ParallelInference._fwd_cache``).
+Keying those caches on the EXACT batch size turns any ragged workload —
+trailing partial batches, a serving frontend with arbitrary request sizes —
+into a recompile-per-shape loop with unbounded cache growth. The fix is the
+same pair everywhere:
+
+- BUCKET the batch dimension: pad up to a canonical size (next power of
+  two, optionally rounded to a worker-count multiple) by replicating the
+  last row — real data, so no degenerate activations — and strip the pad
+  rows from the result. Row-independent inference makes the real rows'
+  outputs unchanged; eval paths additionally zero the pad rows' weights.
+- BOUND the cache: an LRU so a long-lived server cannot hold compiled
+  programs (and their device buffers) for every shape it has ever seen.
+
+``FusedFitDriver`` keeps its own stream-bucket policy (first-batch size,
+zeroed label-mask padding — see optimize/fused_fit.py); these helpers serve
+the inference/eval family where requests arrive one at a time.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import jax.numpy as jnp
+import numpy as np
+
+#: default LRU capacity for inference-program caches. Big enough that a
+#: test suite or a bucketed serving workload never evicts (buckets are
+#: O(log max_batch) per signature); small enough to bound a pathological
+#: shape stream.
+DEFAULT_CACHE_PROGRAMS = 64
+
+
+def bucket_rows(n: int, multiple: int = 1) -> int:
+    """Canonical padded batch size for ``n`` rows: the smallest power of two
+    >= n, rounded up to a ``multiple`` (the mesh worker count, so a sharded
+    batch still splits evenly). Distinct request sizes then collapse onto
+    O(log max_batch) jit signatures instead of one per size."""
+    if n < 1:
+        raise ValueError(f"batch must have at least one row, got {n}")
+    b = 1
+    while b < n:
+        b *= 2
+    if multiple > 1 and b % multiple:
+        b = -(-b // multiple) * multiple
+    return b
+
+
+def pad_rows(a, target: int):
+    """Pad ``a``'s leading dim up to ``target`` by replicating the last row
+    (numpy in, numpy out; jax in, jax out — device arrays are padded on
+    device, no host round-trip)."""
+    pad = target - a.shape[0]
+    if pad <= 0:
+        return a
+    xp = jnp if isinstance(a, jnp.ndarray) else np
+    return xp.concatenate([a, xp.repeat(a[-1:], pad, axis=0)], axis=0)
+
+
+class BoundedCache(OrderedDict):
+    """dict-compatible LRU for jit-program caches: lookups refresh recency,
+    inserts past ``maxsize`` evict the least-recently-used program."""
+
+    def __init__(self, maxsize: int = DEFAULT_CACHE_PROGRAMS):
+        super().__init__()
+        if maxsize < 1:
+            raise ValueError("maxsize must be >= 1")
+        self.maxsize = maxsize
+
+    def __getitem__(self, key):
+        value = super().__getitem__(key)
+        self.move_to_end(key)
+        return value
+
+    def __setitem__(self, key, value):
+        super().__setitem__(key, value)
+        self.move_to_end(key)
+        while len(self) > self.maxsize:
+            self.popitem(last=False)

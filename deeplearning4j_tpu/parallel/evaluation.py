@@ -3,8 +3,12 @@
 Reference: SparkDl4jMultiLayer.evaluate (impl/multilayer/SparkDl4jMultiLayer
 .java:443-540) — executors each evaluate their partitions into an IEvaluation,
 then the results are reduced with IEvaluation.merge. Here the forward pass is
-sharded over the mesh (the "executors"), each batch becomes a partial
-evaluation on host, and the reduce is IEvaluation.merge — same algebra, ICI-fed.
+sharded over the mesh (the "executors") and — by default — the reduce happens
+ON DEVICE: each mesh shard accumulates its confusion/top-N/loss counts inside
+the fused evaluation program and XLA's cross-replica sum IS IEvaluation.merge.
+Only the final [C, C] count matrix crosses to host, once per evaluation run,
+instead of per-batch logit transfers. ``fused=False`` keeps the original
+per-batch map-reduce (sharded forward, host-side eval + merge per batch).
 """
 
 from __future__ import annotations
@@ -16,22 +20,33 @@ import numpy as np
 from jax.sharding import Mesh
 
 from deeplearning4j_tpu.parallel.inference import ParallelInference
+from deeplearning4j_tpu.parallel.mesh import data_mesh
 
 
 def evaluate_on_mesh(net, iterator, mesh: Optional[Mesh] = None,
-                     evaluation=None):
+                     evaluation=None, *, fused: Optional[bool] = None,
+                     eval_batches: Optional[int] = None):
     """Evaluate ``net`` over all batches of ``iterator`` with mesh-sharded
-    forwards; one partial evaluation per batch ("partition"), merged at the
-    end. ``evaluation`` is a prototype instance (deep-copied per partial, so
-    constructor configuration like label names is preserved)."""
+    forwards. ``evaluation`` is a prototype instance (configuration like
+    label names / top_n is preserved in the result). Default path: the
+    device-side fused evaluator with the batch axis sharded over ``mesh``
+    (merge = on-device sum, one host fetch). ``fused=False``: per-batch
+    forward + host-side eval/merge (the original map-reduce)."""
     from deeplearning4j_tpu.evaluation.classification import Evaluation
 
     if evaluation is None:
         evaluation = Evaluation()
-    inf = ParallelInference(net, mesh=mesh)
-    result = None
     if hasattr(iterator, "reset"):
         iterator.reset()
+
+    if fused is None or fused:
+        from deeplearning4j_tpu.evaluation.fused_eval import FusedEvalDriver
+        driver = FusedEvalDriver(net, eval_batches=eval_batches,
+                                 mesh=mesh if mesh is not None else data_mesh())
+        return driver.evaluate(iterator, copy.deepcopy(evaluation))
+
+    inf = ParallelInference(net, mesh=mesh)
+    result = None
     for ds in iterator:
         out = inf.output(ds.features, mask=ds.features_mask)
         partial = copy.deepcopy(evaluation)

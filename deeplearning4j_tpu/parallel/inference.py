@@ -1,15 +1,33 @@
-"""ParallelInference: multi-device batched inference.
+"""ParallelInference: multi-device batched inference + coalescing server.
 
 Reference: parallelism/ParallelInference.java:33 — per-device worker threads,
-an observable queue, and optional request coalescing (BatchedInferenceObservable)
-to batch small requests before dispatch. TPU-native design: the forward pass is
-one jitted program whose batch axis is sharded over the mesh; "dispatching to N
-workers" is a sharding annotation, and request coalescing maps to host-side
-batching with padding to a multiple of the device count.
+an observable queue, and request coalescing (BatchedInferenceObservable:
+small requests are merged into one device batch, each caller gets its slice
+back). TPU-native design: the forward pass is one jitted program whose batch
+axis is sharded over the mesh; "dispatching to N workers" is a sharding
+annotation. The serving surface has two entries:
+
+- ``output(x)`` — synchronous sharded forward (one caller owns the batch).
+- ``submit(x) -> Future`` — the BatchedInferenceObservable analogue: an
+  async handle whose request is COALESCED with concurrent submissions by a
+  background batcher (up to ``max_batch`` rows or a ``max_wait_ms``
+  deadline, whichever first), dispatched as ONE padded-bucket program call,
+  and sliced back per caller. A bounded in-flight queue decouples host
+  batch assembly from device compute: the coalescer assembles and
+  dispatches batch t+1 while the completer thread waits on batch t's
+  device result — jax's async dispatch makes the overlap real.
+
+Both entries share one jit cache, BUCKETED on the batch dim (padded to the
+next power of two, rounded to a worker multiple — optimize/bucketing.py) so
+arbitrary request sizes compile O(log max_batch) programs, and LRU-bounded
+so a long-lived server cannot grow it without bound.
 """
 
 from __future__ import annotations
 
+import queue
+import threading
+from concurrent.futures import Future
 from typing import Optional
 
 import jax
@@ -17,17 +35,54 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu.optimize.bucketing import (BoundedCache, bucket_rows,
+                                                   pad_rows)
 from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, data_mesh
+
+_SHUTDOWN = object()
+
+
+class _Request:
+    """One submitted observable: input rows + the future its slice lands in
+    (the reference's InferenceObservable, minus the wait/notify)."""
+
+    __slots__ = ("x", "mask", "n", "future")
+
+    def __init__(self, x, mask):
+        self.x = x
+        self.mask = mask
+        self.n = x.shape[0]
+        self.future: Future = Future()
+
+    def signature(self):
+        return (self.x.shape[1:], self.mask is not None)
 
 
 class ParallelInference:
     def __init__(self, net, mesh: Optional[Mesh] = None,
-                 workers: Optional[int] = None):
+                 workers: Optional[int] = None, *, max_batch: int = 64,
+                 max_wait_ms: float = 3.0, inflight: int = 2):
+        """``max_batch``/``max_wait_ms`` bound the coalescer: a batch is
+        dispatched when it reaches ``max_batch`` rows or ``max_wait_ms``
+        after its first request, whichever comes first. ``inflight`` bounds
+        the dispatch pipeline (assembled-but-unfetched batches)."""
         self.net = net
         self.mesh = mesh if mesh is not None else data_mesh(workers)
         self.workers = self.mesh.devices.size
-        self._fwd_cache: dict = {}
+        self._fwd_cache = BoundedCache()
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.inflight = max(1, int(inflight))
+        #: device program calls issued (coalescing efficiency metric: N
+        #: submits completing in 1 dispatch is the point of the batcher)
+        self.dispatch_count = 0
+        self._submit_q: Optional[queue.Queue] = None
+        self._inflight_q: Optional[queue.Queue] = None
+        self._threads: list = []
+        self._lock = threading.Lock()
+        self._closed = False
 
+    # ----------------------------------------------------------- jit cache
     def _get_fwd(self, shape, has_mask):
         key = (shape, has_mask)
         if key not in self._fwd_cache:
@@ -35,10 +90,17 @@ class ParallelInference:
             batch_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
             replicated = NamedSharding(self.mesh, P())
 
-            def fwd(params, state, x, mask):
-                out, _, _, _ = net._forward(params, state, x, mask, train=False,
-                                            rng=None)
-                return out
+            if hasattr(net, "layers") and isinstance(net.layers, list):
+                def fwd(params, state, x, mask):
+                    out, _, _, _ = net._forward(params, state, x, mask,
+                                                train=False, rng=None)
+                    return out
+            else:  # ComputationGraph, single input/output
+                def fwd(params, state, x, mask):
+                    outs, _, _, _, _ = net._forward(params, state, [x],
+                                                    [mask], train=False,
+                                                    rng=None)
+                    return outs[0]
 
             self._fwd_cache[key] = jax.jit(
                 fwd,
@@ -47,21 +109,145 @@ class ParallelInference:
                 out_shardings=batch_sharding)
         return self._fwd_cache[key]
 
-    def output(self, x, mask=None):
-        """Sharded forward over the mesh; batch is padded to a multiple of the
-        worker count and the padding stripped from the result (the reference's
-        batched-observable coalescing, minus the threads)."""
-        x = np.asarray(x)
+    def _dispatch_fwd(self, x, mask):
+        """Pad to the bucket, dispatch the sharded forward (async), return
+        the un-fetched device result. The caller strips the padding."""
         n = x.shape[0]
-        W = self.workers
-        pad = (-n) % W
-        if pad:
-            x = np.concatenate([x, np.repeat(x[-1:], pad, axis=0)], axis=0)
+        B = bucket_rows(n, multiple=self.workers)
+        if B != n:
+            x = pad_rows(x, B)
             if mask is not None:
-                mask = np.concatenate(
-                    [np.asarray(mask), np.repeat(np.asarray(mask)[-1:], pad,
-                                                 axis=0)], axis=0)
+                mask = pad_rows(np.asarray(mask), B)
         fwd = self._get_fwd(x.shape, mask is not None)
         out = fwd(self.net.params, self.net.state, jnp.asarray(x),
                   jnp.asarray(mask) if mask is not None else None)
-        return np.asarray(out)[:n]
+        self.dispatch_count += 1
+        return out
+
+    # ---------------------------------------------------------- sync entry
+    def output(self, x, mask=None):
+        """Sharded forward over the mesh; the batch is padded to the bucket
+        size (power of two, worker multiple) and the padding stripped from
+        the result."""
+        x = np.asarray(x)
+        out = self._dispatch_fwd(x, mask)
+        return np.asarray(out)[:x.shape[0]]
+
+    # --------------------------------------------------------- async entry
+    def submit(self, x, mask=None) -> Future:
+        """Async inference: returns a Future of this request's output rows.
+        Requests submitted concurrently are coalesced into one device batch
+        (the reference's BatchedInferenceObservable); each future resolves
+        to exactly its own rows, in row order."""
+        if self._closed:
+            raise RuntimeError("ParallelInference is closed")
+        x = np.asarray(x)
+        if x.ndim < 2:
+            x = x[None]  # single example -> 1-row batch
+        req = _Request(x, None if mask is None else np.asarray(mask))
+        self._ensure_workers()
+        self._submit_q.put(req)
+        return req.future
+
+    def _ensure_workers(self):
+        if self._threads:
+            return
+        with self._lock:
+            if self._threads:
+                return
+            self._submit_q = queue.Queue()
+            # bounded: backpressures the coalescer when `inflight` batches
+            # are dispatched but not yet fetched
+            self._inflight_q = queue.Queue(maxsize=self.inflight)
+            coalescer = threading.Thread(target=self._coalesce_loop,
+                                         name="pi-coalescer", daemon=True)
+            completer = threading.Thread(target=self._complete_loop,
+                                         name="pi-completer", daemon=True)
+            self._threads = [coalescer, completer]
+            coalescer.start()
+            completer.start()
+
+    def _coalesce_loop(self):
+        import time
+
+        q = self._submit_q
+        head = None
+        while True:
+            first = head if head is not None else q.get()
+            head = None
+            if first is _SHUTDOWN:
+                self._inflight_q.put(_SHUTDOWN)
+                return
+            batch = [first]
+            rows = first.n
+            sig = first.signature()
+            deadline = time.monotonic() + self.max_wait_s
+            while rows < self.max_batch:
+                wait = deadline - time.monotonic()
+                if wait <= 0:
+                    break
+                try:
+                    nxt = q.get(timeout=wait)
+                except queue.Empty:
+                    break
+                if nxt is _SHUTDOWN or nxt.signature() != sig:
+                    head = nxt  # flush now; the mismatch starts its own batch
+                    break
+                batch.append(nxt)
+                rows += nxt.n
+            self._dispatch_batch(batch)
+
+    def _dispatch_batch(self, batch):
+        try:
+            x = (batch[0].x if len(batch) == 1
+                 else np.concatenate([r.x for r in batch]))
+            mask = None
+            if batch[0].mask is not None:
+                mask = (batch[0].mask if len(batch) == 1
+                        else np.concatenate([r.mask for r in batch]))
+            out = self._dispatch_fwd(x, mask)  # async dispatch, no fetch
+        except Exception as e:  # noqa: BLE001 — surface on every future
+            for r in batch:
+                r.future.set_exception(e)
+            return
+        # blocks when `inflight` batches are already pending — bounded
+        # pipeline: device compute overlaps the NEXT batch's host assembly
+        self._inflight_q.put((out, batch))
+
+    def _complete_loop(self):
+        while True:
+            item = self._inflight_q.get()
+            if item is _SHUTDOWN:
+                return
+            out, batch = item
+            try:
+                arr = np.asarray(out)  # the device fetch for this batch
+            except Exception as e:  # noqa: BLE001
+                for r in batch:
+                    r.future.set_exception(e)
+                continue
+            ofs = 0
+            for r in batch:
+                r.future.set_result(arr[ofs:ofs + r.n])
+                ofs += r.n
+
+    # ------------------------------------------------------------ lifecycle
+    def close(self):
+        """Flush and stop the coalescer threads (idempotent). Pending
+        futures complete before the threads exit."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            threads, self._threads = self._threads, []
+        if threads:
+            self._submit_q.put(_SHUTDOWN)
+            for t in threads:
+                t.join(timeout=30)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -77,9 +77,59 @@ def _step_recompile_guard(request):
         "allow_step_recompiles if the shapes are genuinely diverse")
 
 
+# Same idea for the inference side: output()/evaluate() route through
+# Model._get_output with shape-bucketed keys (batch padded to a bucket), so
+# a stream of arbitrary batch sizes compiles O(log max_batch) forward
+# programs plus a fused-eval block and its K=1 tail variant. A per-batch
+# leak compiles once per output() call and blows past this cap.
+MAX_OUTPUT_COMPILES_PER_NET = 10
+
+
+@pytest.fixture(autouse=True)
+def _output_recompile_guard(request):
+    if request.node.get_closest_marker("allow_output_recompiles"):
+        yield
+        return
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    counts: dict = {}
+    patched = []
+
+    def instrument(cls):
+        orig = cls._get_output
+
+        def counted(self, key, build, _orig=orig):
+            if key not in self._output_cache:
+                counts[id(self)] = counts.get(id(self), 0) + 1
+            return _orig(self, key, build)
+
+        cls._get_output = counted
+        patched.append((cls, orig))
+
+    instrument(MultiLayerNetwork)
+    instrument(ComputationGraph)
+    try:
+        yield
+    finally:
+        for cls, orig in patched:
+            cls._get_output = orig
+    worst = max(counts.values(), default=0)
+    assert worst <= MAX_OUTPUT_COMPILES_PER_NET, (
+        f"a single network compiled {worst} distinct inference programs in "
+        f"one test (cap {MAX_OUTPUT_COMPILES_PER_NET}) — output()/evaluate() "
+        "is compiling per batch instead of per shape bucket; route through "
+        "the bucketed cache or mark the test @pytest.mark."
+        "allow_output_recompiles if the shapes are genuinely diverse")
+
+
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running test")
     config.addinivalue_line(
         "markers",
         "allow_step_recompiles: opt out of the per-test train-step "
+        "recompile-count guard")
+    config.addinivalue_line(
+        "markers",
+        "allow_output_recompiles: opt out of the per-test inference "
         "recompile-count guard")

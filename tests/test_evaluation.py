@@ -48,6 +48,45 @@ class TestTopN:
         ev = Evaluation().eval(labels, probs)
         assert ev.top_n_accuracy() == ev.accuracy() == 0.5
 
+    def test_top_n_at_least_num_classes_is_all_correct(self):
+        """top_n >= C: the top-N set is all classes, so every example is a
+        hit (and argpartition's kth would be out of range) — hand-computed:
+        3 examples, 3 classes, top_n=3."""
+        probs = np.array([[0.6, 0.3, 0.1],
+                          [0.1, 0.2, 0.7],
+                          [0.4, 0.4, 0.2]])
+        # true classes ranked 3rd, 3rd, tied-1st: top-2 hits only ex2
+        labels = np.eye(3)[[2, 0, 1]]
+        for n in (3, 5):
+            ev = Evaluation(top_n=n).eval(labels, probs)
+            assert ev.top_n_correct == 3
+            assert ev.top_n_total == 3
+            assert ev.top_n_accuracy() == 1.0
+        # boundary below: top_n = C-1 = 2 still uses the ranked path
+        ev = Evaluation(top_n=2).eval(labels, probs)
+        assert ev.top_n_accuracy() == 1 / 3
+
+
+class TestZeroState:
+    def test_per_class_metrics_on_empty_evaluation(self):
+        """Explicit class index on a never-evaluated instance (e.g. a
+        zero-batch worker in the distributed merge): 0.0, not IndexError on
+        the 1x1 placeholder."""
+        ev = Evaluation()
+        assert ev.precision(2) == 0.0
+        assert ev.recall(2) == 0.0
+        assert ev.false_positive_rate(2) == 0.0
+        assert ev.f1(2) == 0.0
+
+    def test_zero_state_merges_cleanly(self):
+        probs = np.array([[0.8, 0.1, 0.1], [0.2, 0.6, 0.2]])
+        labels = np.eye(3)[[0, 1]]
+        full = Evaluation().eval(labels, probs)
+        empty = Evaluation()
+        empty.merge(full)
+        assert empty.precision(0) == full.precision(0)
+        assert empty.recall(1) == full.recall(1)
+
 
 class TestCurveSerde:
     def _roc(self):

@@ -1,0 +1,227 @@
+"""Device-side fused evaluation tests (evaluation/fused_eval.py).
+
+Covers the ISSUE-2 acceptance surface: fused evaluate() matches the
+per-batch host path to EXACT integer counts (confusion matrix, top-N) on
+both network classes, masked time series, ragged tail batches, the
+program-count guarantees of the bucketed inference cache, and the
+mesh-sharded on-device merge.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.evaluation.fused_eval import FusedEvalDriver
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (DenseLayer, OutputLayer,
+                                               RnnOutputLayer, SimpleRnn)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Adam
+
+from tests.test_fused_fit import _graph, _iris_like, _mln
+
+
+def _batches(n, batch_size, seed=0):
+    ds = _iris_like(n, seed=seed)
+    x, y = np.asarray(ds.features), np.asarray(ds.labels)
+    return [DataSet(x[i:i + batch_size], y[i:i + batch_size])
+            for i in range(0, n, batch_size)]
+
+
+def _rnn_net(seed=7):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(0.02))
+            .weight_init("xavier")
+            .list(SimpleRnn(n_out=8, activation="tanh"),
+                  RnnOutputLayer(n_out=3, loss="mcxent",
+                                 activation="softmax"))
+            .set_input_type(InputType.recurrent(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _assert_same_counts(ev_a, ev_b):
+    assert ev_a.confusion is not None and ev_b.confusion is not None
+    np.testing.assert_array_equal(ev_a.confusion, ev_b.confusion)
+    assert ev_a.top_n_correct == ev_b.top_n_correct
+    assert ev_a.top_n_total == ev_b.top_n_total
+
+
+# ------------------------------------------------------------------ parity
+class TestFusedEvalParity:
+    @pytest.mark.parametrize("make_net", [_mln, _graph],
+                             ids=["mln", "graph"])
+    def test_matches_per_batch_exactly(self, make_net):
+        """Fused confusion counts equal the host per-batch path's, as exact
+        integers (the acceptance criterion, not allclose)."""
+        net = make_net()
+        it = ListDataSetIterator(_batches(96, 16), batch_size=16)
+        ev_fused = net.evaluate(it)
+        it.reset()
+        ev_ref = net.evaluate(it, fused=False)
+        _assert_same_counts(ev_fused, ev_ref)
+        assert ev_fused.accuracy() == ev_ref.accuracy()
+
+    @pytest.mark.parametrize("make_net", [_mln, _graph],
+                             ids=["mln", "graph"])
+    def test_top_n_matches(self, make_net):
+        net = make_net()
+        it = ListDataSetIterator(_batches(80, 16, seed=3), batch_size=16)
+        ev_fused = net.evaluate(it, top_n=2)
+        it.reset()
+        ev_ref = net.evaluate(it, top_n=2, fused=False)
+        _assert_same_counts(ev_fused, ev_ref)
+        assert ev_fused.top_n_accuracy() == ev_ref.top_n_accuracy()
+
+    def test_ragged_tail_batches(self):
+        """Undersized trailing batches are padded with zero-weight rows:
+        counts are exactly the unpadded stream's."""
+        net = _mln()
+        ds = _iris_like(86, seed=5)  # 32, 32, 22
+        x, y = np.asarray(ds.features), np.asarray(ds.labels)
+        batches = [DataSet(x[0:32], y[0:32]), DataSet(x[32:64], y[32:64]),
+                   DataSet(x[64:86], y[64:86])]
+        ev_fused = net.evaluate(ListDataSetIterator(batches, batch_size=32))
+        ev_ref = net.evaluate(ListDataSetIterator(batches, batch_size=32),
+                              fused=False)
+        _assert_same_counts(ev_fused, ev_ref)
+        assert int(ev_fused.confusion.sum()) == 86
+
+    def test_masked_time_series(self):
+        """3-D labels: the labels_mask selects timesteps, exactly as the
+        host path's flatten-and-select."""
+        net = _rnn_net()
+        rs = np.random.RandomState(11)
+        batches = []
+        for _ in range(4):
+            x = rs.randn(6, 5, 4).astype(np.float32)
+            y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, (6, 5))]
+            lm = (rs.rand(6, 5) > 0.3).astype(np.float32)
+            im = np.ones((6, 5), np.float32)
+            batches.append(DataSet(x, y, features_mask=im, labels_mask=lm))
+        it = ListDataSetIterator(batches, batch_size=6)
+        ev_fused = net.evaluate(it)
+        it.reset()
+        ev_ref = net.evaluate(it, fused=False)
+        _assert_same_counts(ev_fused, ev_ref)
+        # only unmasked timesteps counted
+        total = sum(int(b.labels_mask.sum()) for b in batches)
+        assert int(ev_fused.confusion.sum()) == total
+
+    def test_eval_loss_attached(self):
+        """The device accumulator tracks the masked mean loss for free; it
+        matches score() on the concatenated stream."""
+        net = _mln()
+        ds = _iris_like(64, seed=2)
+        ev = net.evaluate(ListDataSetIterator(_batches(64, 16, seed=2),
+                                              batch_size=16))
+        assert abs(ev.eval_loss - net.score(ds)) < 1e-5
+
+
+# --------------------------------------------------------- program economy
+class TestProgramCounts:
+    def test_fused_eval_two_programs_per_ragged_stream(self):
+        """A uniform stream with one ragged tail compiles exactly two eval
+        programs: the K-block and its K=1 tail instance."""
+        net = _mln()
+        before = len(net._output_cache)
+        net.evaluate(ListDataSetIterator(_batches(86, 16), batch_size=16))
+        eval_keys = [k for k in net._output_cache
+                     if isinstance(k, tuple) and k and k[0] == "fused_eval"]
+        assert 1 <= len(eval_keys) <= 2
+        assert len(net._output_cache) - before <= 2
+
+    def test_output_bucketing_collapses_programs(self):
+        """output() with batch sizes 1..9 pads to power-of-two buckets:
+        at most 5 programs (1, 2, 4, 8, 16), not 9."""
+        net = _mln()
+        rs = np.random.RandomState(0)
+        full = rs.randn(16, 4).astype(np.float32)
+        for n in range(1, 10):
+            out = net.output(full[:n])
+            assert out.shape[0] == n
+        fwd_keys = [k for k in net._output_cache
+                    if not (isinstance(k, tuple) and k
+                            and k[0] == "fused_eval")]
+        assert len(fwd_keys) <= 5
+
+    def test_output_bucket_padding_is_invisible(self):
+        """Padded rows never leak: bucketed output equals the full-batch
+        slice."""
+        net = _mln()
+        rs = np.random.RandomState(1)
+        x = rs.randn(8, 4).astype(np.float32)
+        full = np.asarray(net.output(x))
+        for n in (1, 3, 5, 7):
+            np.testing.assert_allclose(np.asarray(net.output(x[:n])),
+                                       full[:n], rtol=1e-6, atol=1e-7)
+
+
+# ------------------------------------------------------------------- mesh
+class TestMeshFusedEval:
+    def test_mesh_matches_host_counts(self):
+        """Mesh-sharded fused eval (on-device merge) produces the same
+        integer counts as the single-device host path."""
+        from deeplearning4j_tpu.parallel import evaluate_on_mesh
+
+        net = _mln()
+        it = ListDataSetIterator(_batches(96, 16, seed=9), batch_size=16)
+        ev_mesh = evaluate_on_mesh(net, it)
+        it.reset()
+        ev_ref = net.evaluate(it, fused=False)
+        _assert_same_counts(ev_mesh, ev_ref)
+
+    def test_mesh_unfused_path_still_works(self):
+        from deeplearning4j_tpu.parallel import evaluate_on_mesh
+
+        net = _mln()
+        it = ListDataSetIterator(_batches(64, 16, seed=4), batch_size=16)
+        ev_old = evaluate_on_mesh(net, it, fused=False)
+        it.reset()
+        ev_ref = net.evaluate(it, fused=False)
+        _assert_same_counts(ev_old, ev_ref)
+
+    def test_mesh_ragged_tail(self):
+        """Ragged tails under sharding: padded to a worker multiple, zero
+        weights keep the counts exact."""
+        from deeplearning4j_tpu.parallel import evaluate_on_mesh
+
+        net = _mln()
+        ds = _iris_like(53, seed=6)
+        x, y = np.asarray(ds.features), np.asarray(ds.labels)
+        batches = [DataSet(x[0:16], y[0:16]), DataSet(x[16:32], y[16:32]),
+                   DataSet(x[32:48], y[32:48]), DataSet(x[48:53], y[48:53])]
+        it = ListDataSetIterator(batches, batch_size=16)
+        ev_mesh = evaluate_on_mesh(net, it)
+        it.reset()
+        ev_ref = net.evaluate(it, fused=False)
+        _assert_same_counts(ev_mesh, ev_ref)
+        assert int(ev_mesh.confusion.sum()) == 53
+
+
+# --------------------------------------------------------------- driver API
+class TestDriverEdges:
+    def test_explicit_k(self):
+        net = _mln()
+        it = ListDataSetIterator(_batches(96, 16), batch_size=16)
+        drv = FusedEvalDriver(net, eval_batches=3)
+        from deeplearning4j_tpu.evaluation.classification import Evaluation
+        ev = drv.evaluate(it, Evaluation())
+        it.reset()
+        ev_ref = net.evaluate(it, fused=False)
+        _assert_same_counts(ev, ev_ref)
+
+    def test_bad_k_rejected(self):
+        from deeplearning4j_tpu.evaluation.fused_eval import \
+            resolve_eval_batches
+        with pytest.raises(ValueError):
+            resolve_eval_batches(0)
+
+    def test_evaluate_with_arrays(self):
+        """evaluate(x, y) convenience form routes through the fused path."""
+        net = _mln()
+        ds = _iris_like(32, seed=8)
+        ev = net.evaluate(np.asarray(ds.features), np.asarray(ds.labels))
+        ev_ref = net.evaluate(np.asarray(ds.features),
+                              np.asarray(ds.labels), fused=False)
+        _assert_same_counts(ev, ev_ref)

@@ -1,0 +1,145 @@
+"""Coalescing inference server tests (parallel/inference.py submit()).
+
+The BatchedInferenceObservable contract: concurrent small requests merge
+into one padded device batch (N=32 single-row submits -> <= 2 dispatches),
+every caller gets exactly its own rows back (identical to a sequential
+output() call), the deadline flushes partial batches, and request order is
+preserved within a coalesced batch.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+from tests.test_fused_fit import _graph, _iris_like, _mln
+
+
+def _features(n, seed=0):
+    return np.asarray(_iris_like(n, seed=seed).features)
+
+
+class TestCoalescing:
+    def test_32_submits_coalesce_to_two_dispatches(self):
+        """The acceptance criterion: 32 concurrent 1-row submits complete in
+        at most 2 device dispatches, results identical to output()."""
+        net = _mln()
+        x = _features(32)
+        with ParallelInference(net, workers=8, max_wait_ms=50) as inf:
+            ref = inf.output(x)
+            base = inf.dispatch_count
+            futs = [inf.submit(x[i:i + 1]) for i in range(32)]
+            res = [f.result(timeout=30) for f in futs]
+            assert inf.dispatch_count - base <= 2
+        got = np.concatenate(res)
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_order_preserved_within_batch(self):
+        """Each future resolves to exactly its own rows: distinct inputs map
+        to their own outputs, in submission row order."""
+        net = _mln()
+        x = _features(16, seed=3)
+        with ParallelInference(net, workers=8, max_wait_ms=50) as inf:
+            seq = inf.output(x)
+            futs = [inf.submit(x[i:i + 2]) for i in range(0, 16, 2)]
+            for i, f in enumerate(futs):
+                np.testing.assert_allclose(f.result(timeout=30),
+                                           seq[2 * i:2 * i + 2],
+                                           rtol=1e-5, atol=1e-6)
+
+    def test_deadline_flushes_partial_batch(self):
+        """Fewer than max_batch rows still complete: the max_wait deadline
+        dispatches whatever has arrived."""
+        net = _mln()
+        x = _features(3, seed=1)
+        with ParallelInference(net, workers=8, max_batch=64,
+                               max_wait_ms=5) as inf:
+            ref = inf.output(x)
+            futs = [inf.submit(x[i:i + 1]) for i in range(3)]
+            got = np.concatenate([f.result(timeout=30) for f in futs])
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_max_batch_triggers_immediate_dispatch(self):
+        """Reaching max_batch rows dispatches without waiting out the
+        deadline (a long max_wait must not serialize a full batch)."""
+        net = _mln()
+        x = _features(8, seed=2)
+        with ParallelInference(net, workers=8, max_batch=8,
+                               max_wait_ms=10_000) as inf:
+            futs = [inf.submit(x[i:i + 1]) for i in range(8)]
+            got = np.concatenate([f.result(timeout=30) for f in futs])
+            ref = inf.output(x)
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_multithreaded_submitters(self):
+        """Submissions racing from many threads all resolve correctly."""
+        net = _mln()
+        x = _features(24, seed=4)
+        results = {}
+        with ParallelInference(net, workers=8, max_wait_ms=20) as inf:
+            ref = inf.output(x)
+
+            def worker(i):
+                results[i] = inf.submit(x[i:i + 1]).result(timeout=30)
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(24)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        for i in range(24):
+            np.testing.assert_allclose(results[i], ref[i:i + 1],
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_graph_net_submit(self):
+        """The server works on ComputationGraph too (single-output)."""
+        net = _graph()
+        x = _features(8, seed=5)
+        with ParallelInference(net, workers=8, max_wait_ms=20) as inf:
+            ref = inf.output(x)
+            futs = [inf.submit(x[i:i + 1]) for i in range(8)]
+            got = np.concatenate([f.result(timeout=30) for f in futs])
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+class TestLifecycle:
+    def test_submit_after_close_raises(self):
+        net = _mln()
+        inf = ParallelInference(net, workers=8)
+        inf.submit(_features(1)).result(timeout=30)
+        inf.close()
+        with pytest.raises(RuntimeError):
+            inf.submit(_features(1))
+
+    def test_close_idempotent_and_without_submits(self):
+        net = _mln()
+        inf = ParallelInference(net, workers=8)
+        inf.close()
+        inf.close()
+
+    def test_single_example_promoted_to_batch(self):
+        """A 1-D feature vector is treated as a 1-row batch."""
+        net = _mln()
+        x = _features(1, seed=6)
+        with ParallelInference(net, workers=8, max_wait_ms=5) as inf:
+            out = inf.submit(x[0]).result(timeout=30)
+            ref = inf.output(x)
+        assert out.shape == ref.shape
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+class TestBucketedCache:
+    def test_output_request_sizes_share_buckets(self):
+        """Request sizes 1..9 pad to power-of-two worker-multiple buckets:
+        at most 2 distinct programs (8 and 16 rows with 8 workers)."""
+        net = _mln()
+        x = _features(16, seed=7)
+        inf = ParallelInference(net, workers=8)
+        full = inf.output(x)
+        for n in range(1, 10):
+            np.testing.assert_allclose(inf.output(x[:n]), full[:n],
+                                       rtol=1e-5, atol=1e-6)
+        assert len(inf._fwd_cache) <= 2
