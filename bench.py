@@ -380,6 +380,59 @@ def bench_fit_e2e(batch: int = 1, n_examples: int = 96, reps: int = 5):
     }
 
 
+def bench_guard_overhead(batch: int = 128, n_examples: int = 1024,
+                         reps: int = 5):
+    """Numerical-health guard cost on the fused fit path (optimize/health
+    .py, acceptance: <2%). Times an identical LeNet fused-fit epoch with
+    the guard ON (all-finite reduction + identity-select fused into the
+    step, skip flags riding the block fetch, HealthPolicy.observe on host)
+    vs OFF, and reports the throughput delta as a percentage.
+
+    Config notes: unlike fit_e2e this uses a compute-visible batch — the
+    guard's cost model is O(num_params) reads against O(num_params *
+    batch) step compute plus one extra small host fetch per K-step block,
+    so a tiny batch would measure the guard against dispatch slack instead
+    of against the compute it is amortized by. No listeners on either leg:
+    the guarded no-listener path pays its stats fetch, the unguarded one
+    keeps the device-side score contract, exactly as users get by
+    default. Median of ``reps`` timed epochs per leg, all recorded."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.models import LeNet
+    from deeplearning4j_tpu.optimize.health import HealthPolicy
+
+    rs = np.random.RandomState(4)
+    x = rs.randn(n_examples, 28, 28, 1).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rs.randint(0, 10, n_examples)]
+    iterator = ListDataSetIterator(DataSet(x, y), batch_size=batch)
+
+    def leg(guarded):
+        net = LeNet(num_labels=10).init()
+        # a fresh policy per fit: thresholds high enough that the guard
+        # only ever measures its fast path (nothing in this data skips)
+        guard = ((lambda: HealthPolicy(skip_threshold=10 ** 9,
+                                       spike_factor=1e18))
+                 if guarded else (lambda: None))
+        net.fit(iterator, epochs=1, health_guard=guard())  # compile warm
+        samples = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            net.fit(iterator, epochs=1, health_guard=guard())
+            _sync(net.params)
+            samples.append(n_examples / (time.perf_counter() - t0))
+        return float(np.median(samples)), [round(s, 1) for s in samples]
+
+    off, off_samples = leg(False)
+    on, on_samples = leg(True)
+    return {
+        "guard_off_img_s": _sane("guard_off_img_s", off),
+        "guard_off_samples": off_samples,
+        "guard_on_img_s": _sane("guard_on_img_s", on),
+        "guard_on_samples": on_samples,
+        "guard_overhead_pct": (off - on) / off * 100.0,
+    }
+
+
 def bench_eval_e2e(batch: int = 1, n_examples: int = 96, reps: int = 5):
     """LeNet-MNIST ``evaluate()`` wall clock, END TO END — the eval twin of
     bench_fit_e2e. The per-batch path pays, per minibatch, one Python
@@ -580,6 +633,8 @@ SANITY_CEILING = {
     "lenet_mnist_img_s": 1e8,
     "fit_e2e_img_s": 1e8,
     "eval_e2e_img_s": 1e8,
+    "guard_on_img_s": 1e8,
+    "guard_off_img_s": 1e8,
     "inference_serve_req_s": 1e8,
     "vgg16_bf16_img_s": 1e5,
     "textgen_lstm_tokens_s": 1e9,
@@ -610,6 +665,9 @@ METRIC_UNIT = {
     "eval_e2e_img_s": "img/s",
     "eval_e2e_unfused_img_s": "img/s",
     "eval_e2e_fused_speedup": "x",
+    "guard_on_img_s": "img/s",
+    "guard_off_img_s": "img/s",
+    "guard_overhead_pct": "%",
     "inference_serve_req_s": "req/s",
     "inference_serve_p50_ms": "ms",
     "inference_serve_p99_ms": "ms",
@@ -841,7 +899,7 @@ def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     valid = ("all", "resnet50", "vgg16", "lenet", "lstm", "transformer",
              "word2vec", "doc2vec", "attention", "fit_e2e", "eval_e2e",
-             "inference_serve")
+             "guard_overhead", "inference_serve")
     if which not in valid:
         sys.exit(f"Unknown model '{which}'; choose one of {valid}")
     # persistent XLA compile cache: repeated bench runs skip the
@@ -870,6 +928,9 @@ def main():
     if which in ("all", "eval_e2e"):
         _sub_metric(extras, "eval_e2e", bench_eval_e2e)
         headline and headline.sample("post-eval-e2e")
+    if which in ("all", "guard_overhead"):
+        _sub_metric(extras, "guard_overhead", bench_guard_overhead)
+        headline and headline.sample("post-guard-overhead")
     if which in ("all", "inference_serve"):
         _sub_metric(extras, "inference_serve", bench_inference_serve)
         headline and headline.sample("post-inference-serve")

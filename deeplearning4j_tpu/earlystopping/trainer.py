@@ -33,11 +33,21 @@ class EarlyStoppingListener:
 
 class EarlyStoppingTrainer:
     def __init__(self, config: EarlyStoppingConfiguration, net, train_iterator,
-                 listener: EarlyStoppingListener | None = None):
+                 listener: EarlyStoppingListener | None = None,
+                 health_guard=None):
         self.config = config
         self.net = net
         self.iterator = train_iterator
         self.listener = listener
+        # health guard OFF by default here, unlike net.fit: this loop calls
+        # fit() once per minibatch (a fresh default policy each call would
+        # be stateless), and early stopping has its own divergence handling
+        # — InvalidScoreIterationTerminationCondition terminates the run on
+        # the raw NaN/inf score the guard deliberately leaves visible. Pass
+        # a configured optimize.health.HealthPolicy to enable skip-step
+        # protection under early stopping (one policy, carried across the
+        # per-minibatch fit calls).
+        self.health_guard = health_guard
 
     def fit(self) -> EarlyStoppingResult:
         cfg = self.config
@@ -58,7 +68,7 @@ class EarlyStoppingTrainer:
             terminate_reason = None
             try:
                 for ds in self.iterator:
-                    self.net.fit(ds)
+                    self.net.fit(ds, health_guard=self.health_guard)
                     last = self.net.score_value
                     for c in cfg.iteration_termination_conditions:
                         if c.terminate(last):

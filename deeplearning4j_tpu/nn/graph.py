@@ -52,6 +52,9 @@ class ComputationGraph:
         # score_value contract: array-like scalar, never guaranteed to be a
         # Python float — see MultiLayerNetwork (score() coerces)
         self.score_value = float("nan")
+        # active numerical-health policy (optimize/health.py) — set by fit()
+        # for its duration; see MultiLayerNetwork
+        self._health = None
         self._base_key = None             # cached PRNGKey(seed), see _rng_base
         self._base_key_seed = None
         self._step_cache: dict = {}
@@ -238,12 +241,12 @@ class ComputationGraph:
             self._base_key_seed = self.conf.seed
         return self._base_key
 
-    def _make_step(self, with_carry: bool):
+    def _make_step(self, with_carry: bool, guarded: bool = False):
         from deeplearning4j_tpu.optimize.fused_fit import build_step_core
 
         # shared step body — also scanned by the fused K-step driver and
         # ParallelWrapper's device round (see optimize/fused_fit.py)
-        core = build_step_core(self)
+        core = build_step_core(self, guarded=guarded)
 
         def step(params, opt_state, state, rng, iteration, xs, ys, ims, lms,
                  carry):
@@ -258,9 +261,11 @@ class ComputationGraph:
             if key[0] == "fused":
                 from deeplearning4j_tpu.optimize.fused_fit import \
                     build_fused_step
-                self._step_cache[key] = build_fused_step(self)
+                self._step_cache[key] = build_fused_step(self,
+                                                         guarded=key[-1])
             else:
-                self._step_cache[key] = self._make_step(with_carry=key[-1])
+                self._step_cache[key] = self._make_step(with_carry=key[-2],
+                                                        guarded=key[-1])
         return self._step_cache[key]
 
     def do_step(self, xs, ys, input_masks=None, label_masks=None, carry=None):
@@ -274,65 +279,96 @@ class ComputationGraph:
                 for m in _as_list(label_masks)] if label_masks is not None
                else None)
         with_carry = carry is not None
+        health = self._health
+        guarded = health is not None
         key = (tuple(a.shape for a in xs), tuple(a.shape for a in ys),
                ims is not None and any(m is not None for m in ims),
-               lms is not None and any(m is not None for m in lms), with_carry)
+               lms is not None and any(m is not None for m in lms), with_carry,
+               guarded)
         step = self._get_step(key)
         rng = jax.random.fold_in(self._rng_base(), self.iteration)
-        (self.params, self.updater_state, self.state, new_carry, loss) = step(
+        out = step(
             self.params, self.updater_state, self.state, rng,
             jnp.asarray(self.iteration, jnp.float32), xs, ys, ims, lms,
             carry if with_carry else {})
+        if guarded:
+            (self.params, self.updater_state, self.state, new_carry, loss,
+             skip) = out
+        else:
+            self.params, self.updater_state, self.state, new_carry, loss = out
         self.iteration += 1
         # device scalar, not float(): no forced sync per step (see
         # MultiLayerNetwork.do_step)
         self.score_value = loss
+        it_done = self.iteration
+        if guarded:
+            # observe BEFORE listener dispatch — see MultiLayerNetwork
+            # .do_step: gated checkpointers need this step's skip state
+            score_h, skip_h = jax.device_get((loss, skip))
+            health.observe(self, score_h, skip_h, it_done - 1)
         for listener in self.listeners:
-            listener.iteration_done(self, self.iteration)
+            listener.iteration_done(self, it_done)
         return self.score_value, new_carry
 
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, epochs: int = 1, *,
-            fused_steps: Optional[int] = None, prefetch_depth: int = 2):
+            fused_steps: Optional[int] = None, prefetch_depth: int = 2,
+            health_guard=True):
         """Train on a DataSet / MultiDataSet / iterator of either (reference:
         ComputationGraph.fit :753-1030).
 
         Single-input single-output DataSet streams default to the fused
         K-step fast path (see MultiLayerNetwork.fit and
         optimize/fused_fit.py); ``fused_steps=1`` opts out. MultiDataSet
-        batches and TBPTT always take the per-minibatch path."""
+        batches and TBPTT always take the per-minibatch path.
+
+        ``health_guard`` (default ON): device-side skip of non-finite
+        steps + host-side recovery ladder — see MultiLayerNetwork.fit and
+        optimize/health.py. Pass ``None``/``False`` to opt out, or a
+        configured ``optimize.health.HealthPolicy``."""
         from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
         from deeplearning4j_tpu.optimize.fused_fit import (FusedFitDriver,
                                                            resolve_fused_steps)
+        from deeplearning4j_tpu.optimize.health import resolve_health_policy
 
         if labels is not None:
             data = DataSet(np.asarray(data), np.asarray(labels))
         K = resolve_fused_steps(self, fused_steps)
-        if isinstance(data, (DataSet, MultiDataSet)):
-            if K > 1 and epochs > 1 and isinstance(data, DataSet):
-                # repeated single-batch fit: fuse the epochs loop (this path
-                # fires no epoch listeners, so semantics are unchanged)
-                FusedFitDriver(self, K, prefetch_depth).fit_stream(
-                    data for _ in range(epochs))
+        policy = resolve_health_policy(health_guard)
+        prev_health = self._health
+        if policy is not None:
+            policy.bind(self)
+        self._health = policy
+        try:
+            if isinstance(data, (DataSet, MultiDataSet)):
+                if K > 1 and epochs > 1 and isinstance(data, DataSet):
+                    # repeated single-batch fit: fuse the epochs loop (this
+                    # path fires no epoch listeners, so semantics are
+                    # unchanged)
+                    FusedFitDriver(self, K, prefetch_depth).fit_stream(
+                        data for _ in range(epochs))
+                    return self
+                for _ in range(epochs):
+                    self._fit_batch(data)
                 return self
+            driver = (FusedFitDriver(self, K, prefetch_depth)
+                      if K > 1 else None)
             for _ in range(epochs):
-                self._fit_batch(data)
+                for listener in self.listeners:
+                    listener.on_epoch_start(self)
+                if hasattr(data, "reset"):
+                    data.reset()
+                if driver is not None:
+                    driver.fit_stream(iter(data))
+                else:
+                    for ds in data:
+                        self._fit_batch(ds)
+                for listener in self.listeners:
+                    listener.on_epoch_end(self)
+                self.epoch += 1
             return self
-        driver = (FusedFitDriver(self, K, prefetch_depth) if K > 1 else None)
-        for _ in range(epochs):
-            for listener in self.listeners:
-                listener.on_epoch_start(self)
-            if hasattr(data, "reset"):
-                data.reset()
-            if driver is not None:
-                driver.fit_stream(iter(data))
-            else:
-                for ds in data:
-                    self._fit_batch(ds)
-            for listener in self.listeners:
-                listener.on_epoch_end(self)
-            self.epoch += 1
-        return self
+        finally:
+            self._health = prev_health
 
     def _fit_batch(self, ds):
         from deeplearning4j_tpu.datasets.dataset import MultiDataSet

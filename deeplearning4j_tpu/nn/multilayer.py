@@ -67,6 +67,10 @@ class MultiLayerNetwork:
         # is NEVER guaranteed to be a Python float; coerce via score() (the
         # no-argument form) or float().
         self.score_value = float("nan")
+        # active numerical-health policy (optimize/health.py) — set by fit()
+        # for its duration (health_guard is ON by default there); do_step /
+        # FusedFitDriver / CheckpointListener read it
+        self._health = None
         self._base_key = None             # cached PRNGKey(seed), see _rng_base
         self._base_key_seed = None
         self._step_cache: dict = {}
@@ -230,13 +234,13 @@ class MultiLayerNetwork:
             self._base_key_seed = self.conf.seed
         return self._base_key
 
-    def _make_step(self, with_carry: bool):
+    def _make_step(self, with_carry: bool, guarded: bool = False):
         from deeplearning4j_tpu.optimize.fused_fit import build_step_core
 
         # the step body (forward/loss/grad/regularization/normalization/
         # updater/center-update) is the SHARED core also scanned by the
         # fused K-step driver and ParallelWrapper's device round
-        core = build_step_core(self)
+        core = build_step_core(self, guarded=guarded)
 
         def step(params, opt_state, state, rng, iteration, x, y, input_mask,
                  label_mask, carry):
@@ -255,9 +259,11 @@ class MultiLayerNetwork:
             if key[0] == "fused":
                 from deeplearning4j_tpu.optimize.fused_fit import \
                     build_fused_step
-                self._step_cache[key] = build_fused_step(self)
+                self._step_cache[key] = build_fused_step(self,
+                                                         guarded=key[-1])
             else:
-                self._step_cache[key] = self._make_step(with_carry=key[-1])
+                self._step_cache[key] = self._make_step(with_carry=key[-2],
+                                                        guarded=key[-1])
         return self._step_cache[key]
 
     def do_step(self, x, y, input_mask=None, label_mask=None, carry=None):
@@ -267,25 +273,40 @@ class MultiLayerNetwork:
         input_mask = jnp.asarray(input_mask) if input_mask is not None else None
         label_mask = jnp.asarray(label_mask) if label_mask is not None else None
         with_carry = carry is not None
+        health = self._health
+        guarded = health is not None
         key = (x.shape, y.shape, input_mask is not None, label_mask is not None,
-               with_carry)
+               with_carry, guarded)
         step = self._get_step(key)
         rng = jax.random.fold_in(self._rng_base(), self.iteration)
-        (self.params, self.updater_state, self.state, new_carry, loss) = step(
+        out = step(
             self.params, self.updater_state, self.state, rng,
             jnp.asarray(self.iteration, jnp.float32), x, y, input_mask, label_mask,
             carry if with_carry else {})
+        if guarded:
+            (self.params, self.updater_state, self.state, new_carry, loss,
+             skip) = out
+        else:
+            self.params, self.updater_state, self.state, new_carry, loss = out
         self.iteration += 1
         # score_value stays a device scalar: float() would force a sync every
         # step and stall the dispatch pipeline; it coerces on first use
         self.score_value = loss
+        it_done = self.iteration
+        if guarded:
+            # observe BEFORE listener dispatch: health-gated checkpoint
+            # listeners (elastic.CheckpointListener) must see THIS step's
+            # skip state, and a recovery/raise precedes the listener round
+            score_h, skip_h = jax.device_get((loss, skip))
+            health.observe(self, score_h, skip_h, it_done - 1)
         for listener in self.listeners:
-            listener.iteration_done(self, self.iteration)
+            listener.iteration_done(self, it_done)
         return self.score_value, new_carry
 
     # ------------------------------------------------------------------ fit
     def fit(self, data, labels=None, epochs: int = 1, *,
-            fused_steps: Optional[int] = None, prefetch_depth: int = 2):
+            fused_steps: Optional[int] = None, prefetch_depth: int = 2,
+            health_guard=True):
         """Train. ``data`` may be (features, labels) arrays, a DataSet, or a
         DataSetIterator (reference: MultiLayerNetwork.fit :1047).
 
@@ -296,40 +317,60 @@ class MultiLayerNetwork:
         minibatch. TBPTT always runs unfused. Listeners still fire per
         iteration but scores materialize per block (one device fetch per
         ``fused_steps`` iterations); listener hooks observe end-of-block
-        parameters."""
+        parameters.
+
+        ``health_guard`` (default ON) fuses the numerical-health guard into
+        the step: a non-finite loss/gradient microbatch is skipped on
+        device (identity update) and a host-side recovery ladder handles
+        divergence — LR backoff, then rollback to the last healthy-gated
+        checkpoint (when the policy has a store), then ``DivergenceError``.
+        Pass ``None``/``False`` to opt out, or an
+        ``optimize.health.HealthPolicy`` to configure thresholds and attach
+        an ``elastic.CheckpointStore``. Recovery events fire
+        ``on_health(model, report)`` on attached listeners."""
         from deeplearning4j_tpu.datasets.dataset import DataSet
         from deeplearning4j_tpu.optimize.fused_fit import (FusedFitDriver,
                                                            resolve_fused_steps)
+        from deeplearning4j_tpu.optimize.health import resolve_health_policy
 
         if labels is not None:
             data = DataSet(np.asarray(data), np.asarray(labels))
         K = resolve_fused_steps(self, fused_steps)
-        if isinstance(data, DataSet):
-            if K > 1 and epochs > 1:
-                # repeated single-batch fit: the epochs loop IS the stream —
-                # fuse it (the DataSet path fires no epoch listeners, so
-                # semantics are unchanged)
-                FusedFitDriver(self, K, prefetch_depth).fit_stream(
-                    data for _ in range(epochs))
+        policy = resolve_health_policy(health_guard)
+        prev_health = self._health
+        if policy is not None:
+            policy.bind(self)
+        self._health = policy
+        try:
+            if isinstance(data, DataSet):
+                if K > 1 and epochs > 1:
+                    # repeated single-batch fit: the epochs loop IS the
+                    # stream — fuse it (the DataSet path fires no epoch
+                    # listeners, so semantics are unchanged)
+                    FusedFitDriver(self, K, prefetch_depth).fit_stream(
+                        data for _ in range(epochs))
+                    return self
+                for _ in range(epochs):
+                    self._fit_batch(data)
                 return self
+            driver = (FusedFitDriver(self, K, prefetch_depth)
+                      if K > 1 else None)
             for _ in range(epochs):
-                self._fit_batch(data)
+                for listener in self.listeners:
+                    listener.on_epoch_start(self)
+                if hasattr(data, "reset"):
+                    data.reset()
+                if driver is not None:
+                    driver.fit_stream(iter(data))
+                else:
+                    for ds in data:
+                        self._fit_batch(ds)
+                for listener in self.listeners:
+                    listener.on_epoch_end(self)
+                self.epoch += 1
             return self
-        driver = (FusedFitDriver(self, K, prefetch_depth) if K > 1 else None)
-        for _ in range(epochs):
-            for listener in self.listeners:
-                listener.on_epoch_start(self)
-            if hasattr(data, "reset"):
-                data.reset()
-            if driver is not None:
-                driver.fit_stream(iter(data))
-            else:
-                for ds in data:
-                    self._fit_batch(ds)
-            for listener in self.listeners:
-                listener.on_epoch_end(self)
-            self.epoch += 1
-        return self
+        finally:
+            self._health = prev_health
 
     def _fit_batch(self, ds):
         if self.conf.backprop_type == "tbptt" and ds.features.ndim == 3:

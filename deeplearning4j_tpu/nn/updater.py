@@ -89,6 +89,18 @@ class Updater:
     def lr(self, iteration):
         return self.lr_schedule(self.learning_rate, iteration)
 
+    def scale_lr(self, factor: float) -> float:
+        """Rescale the base learning rate in place (the whole schedule
+        shifts with it) and return the new value. This is the health
+        guard's LR-backoff hook (optimize/health.py): the base lr is a
+        trace-time constant of every compiled step program, so callers
+        MUST invalidate cached jitted steps afterwards — HealthPolicy
+        clears ``net._step_cache`` (and ParallelWrapper's round cache)."""
+        if not factor > 0:
+            raise ValueError(f"scale_lr factor must be > 0, got {factor}")
+        self.learning_rate = self.learning_rate * factor
+        return self.learning_rate
+
     def lr_tree(self, grads, iteration, lr_mult):
         """Per-leaf effective learning rate: schedule(base_lr) * multiplier."""
         lr = self.lr(iteration)

@@ -1,4 +1,5 @@
-"""Optimization: training listeners (reference: optimize/listeners/)."""
+"""Optimization: training listeners (reference: optimize/listeners/) and
+the numerical-health guard (optimize/health.py)."""
 
 from deeplearning4j_tpu.optimize.listeners import (
     TrainingListener,
@@ -7,4 +8,9 @@ from deeplearning4j_tpu.optimize.listeners import (
     CollectScoresIterationListener,
     EvaluativeListener,
     TimeIterationListener,
+    HealthListener,
+)
+from deeplearning4j_tpu.optimize.health import (
+    DivergenceError,
+    HealthPolicy,
 )

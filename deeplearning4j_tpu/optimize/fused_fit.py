@@ -98,7 +98,7 @@ def _center_spec(net):
     return None
 
 
-def build_step_core(net, *, grad_transform=None):
+def build_step_core(net, *, grad_transform=None, guarded=False):
     """One functional SGD step over ``net``'s ``_loss`` contract.
 
     Returns ``core(params, opt_state, state, rng, iteration, x, y,
@@ -106,7 +106,21 @@ def build_step_core(net, *, grad_transform=None):
     new_carry, loss)``. ``grad_transform`` (e.g. a ``lax.pmean``) is applied
     between the closed-form regularization grads and gradient normalization
     — the ordering ParallelWrapper's SHARED_GRADIENTS parity contract needs.
+
+    With ``guarded=True`` the core additionally runs the numerical-health
+    guard (optimize/health.py): one all-finite reduction over the loss and
+    the post-transform gradients; when non-finite, the IDENTITY update is
+    selected (params/opt-state/layer-state/carry pass through unchanged)
+    and the returned tuple gains a trailing ``skip`` scalar (1.0 when the
+    step was skipped) — ``(..., loss, skip)``. The finite check sits after
+    ``grad_transform`` so a SHARED_GRADIENTS ``pmean`` poisons (and skips)
+    all replicas identically, keeping them in lockstep. The raw (possibly
+    non-finite) loss is still reported: the guard protects the weights,
+    not the telemetry. On the all-finite path the select returns the new
+    trees exactly, so guarded and unguarded trajectories are bit-identical.
     """
+    from deeplearning4j_tpu.optimize.health import all_finite, tree_select
+
     updater = net.conf.updater
     lr_mults = net._lr_mult_tree() if hasattr(net, "_lr_mult_tree") else None
     layer_map = layer_map_for(net)
@@ -123,6 +137,8 @@ def build_step_core(net, *, grad_transform=None):
         grads = add_regularization_grads(net, params, grads)
         if grad_transform is not None:
             grads = grad_transform(grads)
+        if guarded:
+            ok = all_finite(loss, grads)
         grads = apply_gradient_normalization(layer_map, grads)
         if lr_mults is not None:
             steps, opt_state2 = updater.step(grads, opt_state, iteration,
@@ -142,25 +158,49 @@ def build_step_core(net, *, grad_transform=None):
                     yy = y[j] if isinstance(y, (list, tuple)) else y
                     new_states[name] = net.conf.vertices[name].layer \
                         .update_centers(state[name], last_in[name], yy)
+        if guarded:
+            # identity update on a poisoned step: everything the step
+            # would have mutated passes through unchanged
+            new_params = tree_select(ok, new_params, params)
+            opt_state2 = tree_select(ok, opt_state2, opt_state)
+            new_states = tree_select(ok, new_states, state)
+            new_carry = tree_select(ok, new_carry, carry)
+            skip = 1.0 - ok.astype(jnp.float32)
+            return (new_params, opt_state2, new_states, new_carry, loss,
+                    skip)
         return new_params, opt_state2, new_states, new_carry, loss
 
     return core
 
 
-def make_scan_body(core, *, rng_fn):
+def make_scan_body(core, *, rng_fn, guarded=False):
     """``lax.scan`` body over ``core``. Carry is ``(params, opt_state,
     state, iteration)``; each scan slot is ``(x, y, im, lm)``. Every slot
     is a real step — the fused driver only dispatches FULL K-blocks
     through the scan (a trailing partial block takes the per-minibatch
-    path instead), so the body needs no per-slot skip machinery: a
+    path instead), so the body needs no per-slot dead-slot machinery: a
     ``lax.cond`` skip was measured to pessimize the whole body 5x on
     XLA:CPU, and a select-based skip pays full dead-slot FLOPs plus a
-    param-tree copy on every live step."""
+    param-tree copy on every live step. (The health guard's where-select
+    is different: it fires only on NON-FINITE steps, a correctness
+    feature, and its cost is bounded by bench.py ``guard_overhead``.)
+
+    With ``guarded=True`` (a ``build_step_core(guarded=True)`` core) the
+    per-slot output is the ``(loss, skip)`` pair instead of the bare loss,
+    so a fused block surfaces its per-step skip flags stacked alongside
+    the stacked losses — still one host fetch per block. The iteration
+    counter advances on skipped steps too, keeping the ``fold_in(base_key,
+    iteration)`` RNG stream — and therefore fused/unfused bit-parity —
+    independent of where the bad batch landed."""
 
     def body(carry, inp):
         params, opt_state, state, it = carry
         x, y, im, lm = inp
         rng = rng_fn(it)
+        if guarded:
+            p2, o2, s2, _, loss, skip = core(params, opt_state, state, rng,
+                                             it, x, y, im, lm, None)
+            return (p2, o2, s2, it + 1.0), (loss, skip)
         p2, o2, s2, _, loss = core(params, opt_state, state, rng, it,
                                    x, y, im, lm, None)
         return (p2, o2, s2, it + 1.0), loss
@@ -181,35 +221,48 @@ def _unroll_fused() -> bool:
     return jax.default_backend() == "cpu"
 
 
-def build_fused_step(net):
+def build_fused_step(net, guarded=False):
     """The fused K-step program: one jitted, buffer-donating K-step loop
     (``lax.scan``, unrolled at trace time on CPU — see ``_unroll_fused``).
 
     ``fused(params, opt_state, state, base_key, it0, xs, ys, ims, lms)
-    -> (params, opt_state, state, losses[K])``. ``xs/ys/ims/lms`` are
-    [K, B, ...] stacks (ims/lms may be None — static, baked per jit
-    signature). The per-slot rng is ``fold_in(base_key, iteration)`` —
-    bit-identical to the unfused ``do_step`` path, so fused and unfused
+    -> (params, opt_state, state, losses[K])`` — with ``guarded=True``
+    the health guard rides inside the program and the outputs gain a
+    trailing ``skips[K]`` stack (see ``build_step_core``). ``xs/ys/ims/
+    lms`` are [K, B, ...] stacks (ims/lms may be None — static, baked per
+    jit signature). The per-slot rng is ``fold_in(base_key, iteration)``
+    — bit-identical to the unfused ``do_step`` path, so fused and unfused
     trajectories match."""
-    core = build_step_core(net)
+    core = build_step_core(net, guarded=guarded)
 
     def fused(params, opt_state, state, base_key, it0, xs, ys, ims, lms):
         body = make_scan_body(
             core,
             rng_fn=lambda it: jax.random.fold_in(base_key,
-                                                 it.astype(jnp.int32)))
+                                                 it.astype(jnp.int32)),
+            guarded=guarded)
         carry = (params, opt_state, state, it0)
         if _unroll_fused():
-            losses = []
+            outs = []
             for k in range(xs.shape[0]):  # static index -> straight-line HLO
-                carry, loss = body(carry, (xs[k], ys[k],
-                                           None if ims is None else ims[k],
-                                           None if lms is None else lms[k]))
-                losses.append(loss)
-            losses = jnp.stack(losses)
+                carry, out = body(carry, (xs[k], ys[k],
+                                          None if ims is None else ims[k],
+                                          None if lms is None else lms[k]))
+                outs.append(out)
+            if guarded:
+                losses = jnp.stack([o[0] for o in outs])
+                skips = jnp.stack([o[1] for o in outs])
+            else:
+                losses = jnp.stack(outs)
         else:
-            carry, losses = lax.scan(body, carry, (xs, ys, ims, lms))
+            carry, scanned = lax.scan(body, carry, (xs, ys, ims, lms))
+            if guarded:
+                losses, skips = scanned
+            else:
+                losses = scanned
         params, opt_state, state, _ = carry
+        if guarded:
+            return params, opt_state, state, losses, skips
         return params, opt_state, state, losses
 
     # params/opt/state are dead after the call (the driver rebinds them from
@@ -373,27 +426,48 @@ class FusedFitDriver:
     def _run_block(self, xs, ys, ims, lms):
         net = self.net
         K = self.K
+        health = getattr(net, "_health", None)
+        guarded = health is not None
         key = ("fused", K, xs.shape, ys.shape,
-               ims is not None, lms is not None)
+               ims is not None, lms is not None, guarded)
         fused = net._get_step(key)
         it0 = net.iteration
-        (net.params, net.updater_state, net.state, losses) = fused(
+        out = fused(
             net.params, net.updater_state, net.state, net._rng_base(),
             jnp.asarray(it0, jnp.float32), xs, ys, ims, lms)
+        skips_h = None
+        if guarded:
+            net.params, net.updater_state, net.state, losses, skips = out
+        else:
+            net.params, net.updater_state, net.state, losses = out
         net.iteration += K
         listeners = net.listeners
-        if not listeners:
+        if not listeners and not guarded:
             # device scalar, no host sync — see the score_value contract
             net.score_value = losses[K - 1]
             return
-        # ONE device fetch per block (not one per step): the whole stacked
-        # loss array comes back together, then listeners fire per step
-        scores = np.asarray(losses)
-        iters = list(range(it0 + 1, it0 + K + 1))
-        for listener in listeners:
-            if hasattr(listener, "on_block_done"):
-                listener.on_block_done(net, iters, scores)
-        for k, it in enumerate(iters):
-            net.score_value = scores[k]
+        if guarded:
+            # still ONE host fetch per block: the stacked losses and the
+            # stacked skip flags come back together. Observe BEFORE the
+            # listener round so health-gated checkpoint listeners see this
+            # block's skip state, and a recovery (or DivergenceError)
+            # precedes — or suppresses — the block's listener dispatch.
+            scores, skips_h = map(np.asarray,
+                                  jax.device_get((losses, skips)))
+            health.observe(net, scores, skips_h, it0)
+        else:
+            # ONE device fetch per block (not one per step): the whole
+            # stacked loss array comes back, then listeners fire per step
+            scores = np.asarray(losses)
+        if not listeners:
+            # no listeners: score_value keeps the device-side contract
+            net.score_value = losses[K - 1]
+        else:
+            iters = list(range(it0 + 1, it0 + K + 1))
             for listener in listeners:
-                listener.iteration_done(net, it)
+                if hasattr(listener, "on_block_done"):
+                    listener.on_block_done(net, iters, scores)
+            for k, it in enumerate(iters):
+                net.score_value = scores[k]
+                for listener in listeners:
+                    listener.iteration_done(net, it)

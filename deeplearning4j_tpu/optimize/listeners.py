@@ -50,6 +50,15 @@ class TrainingListener:
         floats, e.g. {"host_prep_ms": ..., "device_round_ms": ...}."""
         pass
 
+    def on_health(self, model, report: dict):
+        """Fired by the numerical-health guard (optimize/health.py) when
+        it observes skipped (non-finite) steps or takes a recovery action.
+        ``report["action"]`` is one of ``"skip"``, ``"lr_backoff"``,
+        ``"rollback"``, ``"raise"``; the remaining keys carry the trigger
+        (``reason``), the iteration, and action-specific detail (skip
+        counts, lr before/after, restored iteration)."""
+        pass
+
 
 class ScoreIterationListener(TrainingListener):
     """Log score every N iterations (reference: ScoreIterationListener)."""
@@ -165,6 +174,24 @@ class ProfilerListener(TrainingListener):
             jax.profiler.stop_trace()
             self._active = False
             log.info("profiler trace written to %s", self.log_dir)
+
+
+class HealthListener(TrainingListener):
+    """Collect (and optionally log) health-guard reports — skipped
+    non-finite steps, LR backoffs, checkpoint rollbacks — emitted by
+    ``optimize.health.HealthPolicy`` through the standard listener
+    interface. Attach like any other listener; ``reports`` accumulates
+    every event dict in order."""
+
+    def __init__(self, log_events: bool = True):
+        self.reports: list = []
+        self.log_events = log_events
+
+    def on_health(self, model, report: dict):
+        self.reports.append(report)
+        if self.log_events:
+            log.warning("health event at iteration %s: %s",
+                        report.get("iteration"), report)
 
 
 class ModelSavingCallback(TrainingListener):

@@ -20,8 +20,12 @@ class _MeshFitAdapter:
         self.pw = pw
         self._buf: list = []
         self._expected_batch = None
+        # one policy carried across the per-minibatch fit calls, so skip
+        # runs / recovery counters span rounds (resolved on first use)
+        self._policy = None
+        self._policy_src = None
 
-    def fit(self, ds):
+    def fit(self, ds, health_guard=None):
         import numpy as np
 
         b = np.asarray(ds.features).shape[0]
@@ -34,8 +38,29 @@ class _MeshFitAdapter:
         self._buf.append(ds)
         need = self.pw.workers * self.pw.averaging_frequency
         if len(self._buf) >= need:
-            self.pw._fit_round(self._buf[:need])
+            self._run_round(self._buf[:need], health_guard)
             self._buf = self._buf[need:]
+
+    def _run_round(self, batches, health_guard):
+        from deeplearning4j_tpu.optimize.health import resolve_health_policy
+
+        pw = self.pw
+        if health_guard is not self._policy_src:
+            self._policy_src = health_guard
+            self._policy = resolve_health_policy(health_guard)
+        policy = self._policy
+        # same binding dance as ParallelWrapper.fit, scoped to one round
+        prev_health = getattr(pw.net, "_health", None)
+        pw._policy = policy
+        if policy is not None:
+            policy.bind(pw.net, invalidate=pw._invalidate_programs)
+            pw.net._health = policy
+        try:
+            pw._fit_round(batches)
+        finally:
+            pw._policy = None
+            if policy is not None:
+                pw.net._health = prev_health
 
     def __getattr__(self, name):
         return getattr(self.pw.net, name)
@@ -45,10 +70,10 @@ class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
     def __init__(self, config, net, train_iterator,
                  mesh: Optional[Mesh] = None, workers: Optional[int] = None,
                  averaging_frequency: int = 1, mode: str = "shared_gradients",
-                 listener=None):
+                 listener=None, health_guard=None):
         pw = ParallelWrapper(net, mesh=mesh, workers=workers,
                              averaging_frequency=averaging_frequency,
-                             mode=mode)
+                             mode=mode, health_guard=health_guard)
         super().__init__(config, _MeshFitAdapter(pw), train_iterator,
-                         listener=listener)
+                         listener=listener, health_guard=health_guard)
         self.wrapper = pw

@@ -195,19 +195,35 @@ class CheckpointListener(TrainingListener):
     """Checkpoint every ``frequency`` iterations through the standard
     listener hook (reference analog: ModelSavingCallback,
     optimize/listeners/callbacks/ModelSavingCallback.java — which has no
-    atomicity or corruption handling)."""
+    atomicity or corruption handling).
+
+    ``health_gated`` (default True): when the model is training under an
+    active health guard (optimize/health.py), a save opportunity that
+    falls inside an unhealthy window — non-finite steps were skipped since
+    the last save — is passed over, so the newest checkpoint stays a true
+    last-known-good for the guard's rollback rung. No guard active means
+    no gating."""
 
     def __init__(self, store: CheckpointStore, frequency: int = 100,
-                 meta_fn: Optional[Callable[[], dict]] = None):
+                 meta_fn: Optional[Callable[[], dict]] = None,
+                 health_gated: bool = True):
         self.store = store
         self.frequency = frequency
         self.meta_fn = meta_fn
+        self.health_gated = health_gated
         self.saved = 0
+        self.skipped_unhealthy = 0
 
     def iteration_done(self, model, iteration: int):
-        if iteration % self.frequency == 0:
-            self.store.save(model, self.meta_fn() if self.meta_fn else None)
-            self.saved += 1
+        if iteration % self.frequency != 0:
+            return
+        if self.health_gated:
+            health = getattr(model, "_health", None)
+            if health is not None and not health.healthy_to_save():
+                self.skipped_unhealthy += 1
+                return
+        self.store.save(model, self.meta_fn() if self.meta_fn else None)
+        self.saved += 1
 
 
 class FaultTolerantTrainer:
@@ -226,6 +242,11 @@ class FaultTolerantTrainer:
         self.store = store
         self.frequency = frequency
         self._batch_in_epoch = 0
+        # net.iteration as of entering the in-flight batch, None between
+        # batches — lets the emergency save tell a crash that landed AFTER
+        # the update was applied (a listener raising post-step) from one
+        # before it, so resume neither retrains nor drops that batch
+        self._iter_at_batch_start: Optional[int] = None
 
     # ------------------------------------------------------------- meta
     def _meta(self) -> dict:
@@ -235,6 +256,16 @@ class FaultTolerantTrainer:
     # -------------------------------------------------------------- fit
     def fit(self, iterator_factory: Callable[[], object], epochs: int,
             start_epoch: int = 0, skip_batches: int = 0):
+        try:
+            return self._fit_loop(iterator_factory, epochs, start_epoch,
+                                  skip_batches)
+        except BaseException as exc:
+            # best-effort emergency checkpoint at the crash point, so a
+            # restart resumes from HERE instead of the last periodic save
+            self._emergency_save(exc)
+            raise
+
+    def _fit_loop(self, iterator_factory, epochs, start_epoch, skip_batches):
         net = self.net
         for epoch in range(start_epoch, epochs):
             net.epoch = epoch
@@ -249,7 +280,9 @@ class FaultTolerantTrainer:
                     skip_batches -= 1
                     self._batch_in_epoch += 1
                     continue
+                self._iter_at_batch_start = net.iteration
                 net._fit_batch(ds)
+                self._iter_at_batch_start = None
                 self._batch_in_epoch += 1
                 if net.iteration % self.frequency == 0:
                     self.store.save(net, self._meta())
@@ -271,6 +304,31 @@ class FaultTolerantTrainer:
         self.store.save(net, {"epoch": epochs, "batch_in_epoch": 0,
                               "complete": True})
         return net
+
+    def _emergency_save(self, exc) -> None:
+        """Crash checkpoint, guarded so a second failure (disk full, net in
+        a broken state) cannot mask the original exception."""
+        try:
+            net = self.net
+            batch = self._batch_in_epoch
+            if (self._iter_at_batch_start is not None
+                    and net.iteration > self._iter_at_batch_start):
+                # the update(s) for the in-flight batch were applied before
+                # the raise (e.g. a listener crashed post-step) but the
+                # position counter had not advanced yet — count the batch as
+                # trained so resume does not apply it twice
+                batch += 1
+            self.store.save(net, {"epoch": net.epoch,
+                                  "batch_in_epoch": batch,
+                                  "emergency": True,
+                                  "error": repr(exc)})
+        except BaseException as save_exc:  # noqa: BLE001 — must not mask exc
+            try:
+                warnings.warn(
+                    f"emergency checkpoint failed ({save_exc!r}); resuming "
+                    "will fall back to the last periodic checkpoint")
+            except BaseException:
+                pass
 
     # -------------------------------------------------------------- run
     def run(self, iterator_factory: Callable[[], object], epochs: int):
@@ -321,9 +379,14 @@ class Heartbeat:
     multi-slice DCN liveness: a worker wedged inside a device step stops
     heartbeating even though its process is alive."""
 
+    #: consecutive beat() failures before the loop surfaces a warning
+    WARN_AFTER_FAILURES = 5
+
     def __init__(self, path: str, interval: float = 1.0):
         self.path = path
         self.interval = interval
+        self.consecutive_failures = 0
+        self._warned = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -340,8 +403,23 @@ class Heartbeat:
         return self
 
     def _loop(self) -> None:
+        # a transient OSError from beat() (disk-full, NFS blip) must NOT
+        # kill the loop — a dead heartbeat thread reads as a dead WORKER to
+        # every observer. Keep beating; the next success clears the streak.
         while not self._stop.wait(self.interval):
-            self.beat()
+            try:
+                self.beat()
+                self.consecutive_failures = 0
+                self._warned = False
+            except OSError as e:
+                self.consecutive_failures += 1
+                if (self.consecutive_failures >= self.WARN_AFTER_FAILURES
+                        and not self._warned):
+                    self._warned = True
+                    warnings.warn(
+                        f"heartbeat {self.path} failed "
+                        f"{self.consecutive_failures} consecutive times "
+                        f"({e!r}); still retrying")
 
     def stop(self) -> None:
         self._stop.set()

@@ -147,7 +147,24 @@ class ParallelInference:
         req = _Request(x, None if mask is None else np.asarray(mask))
         self._ensure_workers()
         self._submit_q.put(req)
+        if self._closed and not req.future.done():
+            # close() raced this submit past the _closed check above: the
+            # request may sit behind the shutdown sentinel (or behind
+            # close()'s queue drain) where no thread will ever serve it —
+            # fail it rather than hang the caller. _fail tolerates the
+            # other side of the race having resolved it first.
+            self._fail(req.future,
+                       RuntimeError("ParallelInference is closed"))
         return req.future
+
+    @staticmethod
+    def _fail(future: Future, exc: Exception) -> None:
+        """set_exception tolerating an already-resolved future (the
+        completer and a closing drain can race on shutdown)."""
+        try:
+            future.set_exception(exc)
+        except Exception:  # noqa: BLE001 — already resolved, either way
+            pass
 
     def _ensure_workers(self):
         if self._threads:
@@ -208,7 +225,7 @@ class ParallelInference:
             out = self._dispatch_fwd(x, mask)  # async dispatch, no fetch
         except Exception as e:  # noqa: BLE001 — surface on every future
             for r in batch:
-                r.future.set_exception(e)
+                self._fail(r.future, e)
             return
         # blocks when `inflight` batches are already pending — bounded
         # pipeline: device compute overlaps the NEXT batch's host assembly
@@ -224,26 +241,45 @@ class ParallelInference:
                 arr = np.asarray(out)  # the device fetch for this batch
             except Exception as e:  # noqa: BLE001
                 for r in batch:
-                    r.future.set_exception(e)
+                    self._fail(r.future, e)
                 continue
             ofs = 0
             for r in batch:
-                r.future.set_result(arr[ofs:ofs + r.n])
+                try:
+                    r.future.set_result(arr[ofs:ofs + r.n])
+                except Exception:  # noqa: BLE001 — lost a shutdown race
+                    pass
                 ofs += r.n
 
     # ------------------------------------------------------------ lifecycle
     def close(self):
         """Flush and stop the coalescer threads (idempotent). Pending
-        futures complete before the threads exit."""
+        futures complete before the threads exit; requests that raced the
+        shutdown in behind the sentinel are FAILED with RuntimeError,
+        never left unresolved."""
         with self._lock:
             if self._closed:
                 return
             self._closed = True
             threads, self._threads = self._threads, []
+            submit_q = self._submit_q
         if threads:
-            self._submit_q.put(_SHUTDOWN)
+            submit_q.put(_SHUTDOWN)
             for t in threads:
                 t.join(timeout=30)
+        if submit_q is None:
+            return
+        # drain anything a racing submit() slipped in behind the sentinel —
+        # the coalescer exited at the sentinel, so these would otherwise
+        # hold unresolved futures forever
+        while True:
+            try:
+                req = submit_q.get_nowait()
+            except queue.Empty:
+                break
+            if req is not _SHUTDOWN:
+                self._fail(req.future,
+                           RuntimeError("ParallelInference is closed"))
 
     def __enter__(self):
         return self

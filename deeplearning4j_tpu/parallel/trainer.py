@@ -62,7 +62,7 @@ class ParallelWrapper:
     def __init__(self, net, workers: Optional[int] = None,
                  averaging_frequency: int = 1, mode: str = AVERAGING,
                  average_updaters: bool = True, mesh: Optional[Mesh] = None,
-                 report_score: bool = True):
+                 report_score: bool = True, health_guard=True):
         if mode not in (AVERAGING, SHARED_GRADIENTS):
             raise ValueError(f"Unknown mode '{mode}'")
         if averaging_frequency < 1:
@@ -74,6 +74,12 @@ class ParallelWrapper:
         self.mode = mode
         self.average_updaters = average_updaters
         self.report_score = report_score
+        # numerical-health guard (optimize/health.py): the guarded step core
+        # skips non-finite worker steps on device and the policy handles
+        # divergence host-side. True -> default policy per fit() call,
+        # None/False -> off, or pass a configured HealthPolicy.
+        self.health_guard = health_guard
+        self._policy = None  # active policy, set for the duration of fit()
         # mid-stream batches whose size didn't match the stream's (dropped
         # with a warning — see fit); genuine trailing partials not counted
         self.dropped_batches = 0
@@ -82,7 +88,7 @@ class ParallelWrapper:
         self._round_cache: dict = {}
 
     # ------------------------------------------------------------------ build
-    def _build_round(self, has_im: bool, has_lm: bool):
+    def _build_round(self, has_im: bool, has_lm: bool, guarded: bool):
         net = self.net
         pmean_grads = self.mode == SHARED_GRADIENTS
         avg_params = self.mode == AVERAGING
@@ -92,11 +98,15 @@ class ParallelWrapper:
         # pmean hook runs between regularization and normalization, so
         # SHARED_GRADIENTS normalizes the GLOBAL gradient exactly as a single
         # device would on the concatenated batch (the module's parity
-        # contract) while AVERAGING normalizes each worker's local step
+        # contract) while AVERAGING normalizes each worker's local step.
+        # Under the guard the same ordering means a SHARED_GRADIENTS pmean
+        # poisons every replica identically, so all replicas skip the same
+        # step and stay in lockstep.
         core = build_step_core(
             net,
             grad_transform=((lambda g: lax.pmean(g, DATA_AXIS))
-                            if pmean_grads else None))
+                            if pmean_grads else None),
+            guarded=guarded)
 
         def device_round(params, opt, state, rng, it0, xs, ys, ims, lms):
             """Runs on ONE device's shard: F local steps, then averaging.
@@ -116,9 +126,14 @@ class ParallelWrapper:
             body = make_scan_body(
                 sharded_core,
                 rng_fn=lambda it: jax.random.fold_in(
-                    jax.random.fold_in(rng, it.astype(jnp.int32)), didx))
-            (params, opt, state, _), losses = lax.scan(
+                    jax.random.fold_in(rng, it.astype(jnp.int32)), didx),
+                guarded=guarded)
+            (params, opt, state, _), scanned = lax.scan(
                 body, (params, opt, state, it0), (xs, ys, ims, lms))
+            if guarded:
+                losses, skip_flags = scanned
+            else:
+                losses = scanned
             if avg_params:
                 params = lax.pmean(params, DATA_AXIS)
                 if average_updaters:
@@ -126,23 +141,37 @@ class ParallelWrapper:
             # persistent layer state (e.g. BN running stats) is averaged like the
             # reference's full-model averaging
             state = lax.pmean(state, DATA_AXIS)
+            if guarded:
+                # per-step stats kept: [F] mean losses + [F] skip fractions
+                # (fraction of workers that skipped that local step) — one
+                # pair of small fetches per round for the health policy
+                losses = lax.pmean(losses, DATA_AXIS)
+                skips = lax.pmean(skip_flags, DATA_AXIS)
+                return params, opt, state, losses, skips
             loss = lax.pmean(jnp.mean(losses), DATA_AXIS)
             return params, opt, state, loss
 
         batch_spec = P(None, DATA_AXIS)
+        n_out = 5 if guarded else 4
         fn = _shard_map(
             device_round, mesh=self.mesh,
             in_specs=(P(), P(), P(), P(), P(),
                       batch_spec, batch_spec, batch_spec, batch_spec),
-            out_specs=(P(), P(), P(), P()),
+            out_specs=(P(),) * n_out,
             **{_SHARD_MAP_CHECK_KW: False})
         # params/opt/state are rebound from the round's outputs
         return jax.jit(fn, donate_argnums=(0, 1, 2))
 
     def _get_round(self, key):
         if key not in self._round_cache:
-            self._round_cache[key] = self._build_round(key[-2], key[-1])
+            self._round_cache[key] = self._build_round(key[-3], key[-2],
+                                                       key[-1])
         return self._round_cache[key]
+
+    def _invalidate_programs(self):
+        """Health-policy hook: the base LR is baked into the compiled round
+        (and step) programs, so an LR backoff must drop them."""
+        self._round_cache.clear()
 
     # -------------------------------------------------------------------- fit
     def fit(self, iterator, epochs: int = 1):
@@ -150,50 +179,67 @@ class ParallelWrapper:
         .fit :409-487 — each worker consumes its own minibatches; incomplete
         final rounds are dropped, matching the reference's skip of trailing
         partial worker groups)."""
+        from deeplearning4j_tpu.optimize.health import resolve_health_policy
+
         net = self.net
         W, F = self.workers, self.averaging_frequency
         need = W * F
         expected_batch = None
-        for _ in range(epochs):
-            for listener in getattr(net, "listeners", []):
-                listener.on_epoch_start(net)
-            if hasattr(iterator, "reset"):
-                iterator.reset()
-            buf = []
-            stream = iter(iterator)
-            ds = next(stream, None)
-            while ds is not None:
-                nxt = next(stream, None)
-                b = np.asarray(ds.features).shape[0]
-                if expected_batch is None:
-                    expected_batch = b
-                if b != expected_batch:
-                    # a genuinely-final undersized minibatch is a trailing
-                    # partial: skipped silently like trailing partial worker
-                    # groups (static shapes keep one XLA program). Any OTHER
-                    # mismatch is data the caller expects to train on —
-                    # count it and warn instead of silently losing it.
-                    if not (nxt is None and b < expected_batch):
-                        self.dropped_batches += 1
-                        warnings.warn(
-                            f"ParallelWrapper dropped a mid-stream minibatch "
-                            f"of size {b} (expected {expected_batch}): all "
-                            f"non-trailing minibatches must share one batch "
-                            f"size ({self.dropped_batches} dropped so far)",
-                            stacklevel=2)
+        policy = resolve_health_policy(self.health_guard)
+        prev_health = getattr(net, "_health", None)
+        self._policy = policy
+        if policy is not None:
+            policy.bind(net, invalidate=self._invalidate_programs)
+            # expose on the net too, so health-gated checkpoint listeners
+            # (elastic.CheckpointListener) see the active policy
+            net._health = policy
+        try:
+            for _ in range(epochs):
+                for listener in getattr(net, "listeners", []):
+                    listener.on_epoch_start(net)
+                if hasattr(iterator, "reset"):
+                    iterator.reset()
+                buf = []
+                stream = iter(iterator)
+                ds = next(stream, None)
+                while ds is not None:
+                    nxt = next(stream, None)
+                    b = np.asarray(ds.features).shape[0]
+                    if expected_batch is None:
+                        expected_batch = b
+                    if b != expected_batch:
+                        # a genuinely-final undersized minibatch is a trailing
+                        # partial: skipped silently like trailing partial
+                        # worker groups (static shapes keep one XLA program).
+                        # Any OTHER mismatch is data the caller expects to
+                        # train on — count it and warn instead of silently
+                        # losing it.
+                        if not (nxt is None and b < expected_batch):
+                            self.dropped_batches += 1
+                            warnings.warn(
+                                f"ParallelWrapper dropped a mid-stream "
+                                f"minibatch of size {b} (expected "
+                                f"{expected_batch}): all non-trailing "
+                                f"minibatches must share one batch size "
+                                f"({self.dropped_batches} dropped so far)",
+                                stacklevel=2)
+                        ds = nxt
+                        continue
+                    buf.append(ds)
+                    if len(buf) == need:
+                        self._fit_round(buf)
+                        buf = []
                     ds = nxt
-                    continue
-                buf.append(ds)
-                if len(buf) == need:
-                    self._fit_round(buf)
-                    buf = []
-                ds = nxt
-            # trailing partial group: dropped (reference parity)
-            for listener in getattr(net, "listeners", []):
-                listener.on_epoch_end(net)
-            if hasattr(net, "epoch"):
-                net.epoch += 1
-        return self.net
+                # trailing partial group: dropped (reference parity)
+                for listener in getattr(net, "listeners", []):
+                    listener.on_epoch_end(net)
+                if hasattr(net, "epoch"):
+                    net.epoch += 1
+            return self.net
+        finally:
+            self._policy = None
+            if policy is not None:
+                net._health = prev_health
 
     def _fit_round(self, batches):
         """One averaging round from W*F host minibatches."""
@@ -225,26 +271,43 @@ class ParallelWrapper:
             return fwb.reshape(F, W * a.shape[1], *a.shape[2:])
 
         feats, labs, ims, lms = map(regroup, (feats, labs, ims, lms))
-        key = (feats.shape, labs.shape, has_im, has_lm)
+        guarded = self._policy is not None
+        key = (feats.shape, labs.shape, has_im, has_lm, guarded)
         rnd = self._get_round(key)
         t_dev0 = time.perf_counter()
         base = (net._rng_base() if hasattr(net, "_rng_base")
                 else jax.random.PRNGKey(net.conf.seed))
         rng = jax.random.fold_in(base, net.iteration)
-        params, opt, state, loss = rnd(
+        out = rnd(
             net.params, net.updater_state, net.state, rng,
             jnp.asarray(net.iteration, jnp.float32), feats, labs, ims, lms)
+        scores_h = skips_h = None
+        if guarded:
+            params, opt, state, losses, skips = out
+        else:
+            params, opt, state, loss = out
         net.params, net.updater_state, net.state = params, opt, state
+        it0 = net.iteration
         net.iteration += F
         listeners = getattr(net, "listeners", [])
-        # timings need a device sync; report_score already pays one.
-        # report_score=False exists precisely to let the next round's
-        # host prep overlap the device compute — only a listener that
-        # actually consumes phase timings may re-introduce the block.
+        # timings need a device sync; report_score already pays one — as
+        # does the guarded round's stats fetch. report_score=False exists
+        # precisely to let the next round's host prep overlap the device
+        # compute — only the guard or a listener that actually consumes
+        # phase timings may re-introduce the block.
         want_timings = self.report_score or any(
             type(ls).on_phase_timings is not TrainingListener.on_phase_timings
             for ls in listeners)
-        if self.report_score:
+        if guarded:
+            # ONE small host fetch per round: [F] mean losses + [F] skip
+            # fractions together
+            scores_h, skips_h = map(np.asarray,
+                                    jax.device_get((losses, skips)))
+            if self.report_score:
+                # mean over the round's F per-step pmean'd losses — equal to
+                # the unguarded round's pmean(mean(losses)) scalar
+                net.score_value = float(np.mean(scores_h))
+        elif self.report_score:
             net.score_value = float(loss)  # forces device round completion
         elif want_timings:
             jax.block_until_ready(loss)
@@ -265,8 +328,14 @@ class ParallelWrapper:
             }
             for listener in listeners:
                 listener.on_phase_timings(net, dict(self.last_phase_timings))
+        it_done = net.iteration
+        if guarded:
+            # may back off the LR (dropping cached rounds), roll back, or
+            # raise — BEFORE the listener round, so gated checkpoint
+            # listeners see this round's skip state
+            self._policy.observe(net, scores_h, skips_h, it0)
         for listener in listeners:
-            listener.iteration_done(net, net.iteration)
+            listener.iteration_done(net, it_done)
 
     # ------------------------------------------------------------- utilities
     def average_models(self):

@@ -127,6 +127,10 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running test")
     config.addinivalue_line(
         "markers",
+        "health: numerical-health guard / NaN-injection tests (CPU-fast; "
+        "runs in tier-1, deliberately NOT in the slow set)")
+    config.addinivalue_line(
+        "markers",
         "allow_step_recompiles: opt out of the per-test train-step "
         "recompile-count guard")
     config.addinivalue_line(

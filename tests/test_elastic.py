@@ -331,6 +331,60 @@ class TestProcessKillRecovery:
         assert abs(float(line.split()[2]) - float(ref.split()[2])) < 1e-4
 
 
+class TestEmergencyCheckpoint:
+    def test_crash_writes_emergency_checkpoint(self, tmp_path):
+        """A raise anywhere in the fit loop leaves a best-effort checkpoint
+        at the crash point, so restart resumes from HERE rather than the
+        last periodic save (frequency here is too large to ever fire)."""
+        batches = _batches(6)
+        factory = lambda: ListDataSetIterator(list(batches), batch_size=16)
+
+        base = _net()
+        for ds in batches:
+            base._fit_batch(ds)
+
+        store = CheckpointStore(str(tmp_path), keep=5)
+        net = _net()
+        net.set_listeners(FaultInjectionListener(at_iteration=3))
+        trainer = FaultTolerantTrainer(net, store, frequency=10_000)
+        with pytest.raises(FaultInjectionListener.InjectedFault):
+            trainer.fit(factory, epochs=1)
+        restored, meta = store.restore()
+        assert meta["emergency"] is True
+        assert "InjectedFault" in meta["error"]
+        # the listener raised AFTER iteration 3's update was applied: the
+        # in-flight batch counts as trained, so resume starts at batch 3
+        assert meta["epoch"] == 0 and meta["batch_in_epoch"] == 3
+        assert restored.iteration == 3
+
+        # restarted process: resumes from the emergency point and ends
+        # identical to the uninterrupted run
+        trainer2 = FaultTolerantTrainer(_net(seed=9), store, frequency=10_000)
+        final = trainer2.run(factory, epochs=1)
+        assert final.iteration == base.iteration == 6
+        np.testing.assert_allclose(
+            np.asarray(final.params_flat(), np.float32),
+            np.asarray(base.params_flat(), np.float32), rtol=0, atol=0)
+
+    def test_emergency_save_failure_never_masks_original(self, tmp_path,
+                                                         monkeypatch):
+        """A second failure inside the emergency save (disk full) must warn
+        and re-raise the ORIGINAL exception, not its own."""
+        store = CheckpointStore(str(tmp_path))
+        net = _net()
+        net.set_listeners(FaultInjectionListener(at_iteration=2))
+        trainer = FaultTolerantTrainer(net, store, frequency=10_000)
+
+        def broken_save(*a, **kw):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(store, "save", broken_save)
+        factory = lambda: ListDataSetIterator(_batches(4), batch_size=16)
+        with pytest.warns(UserWarning, match="emergency checkpoint failed"), \
+                pytest.raises(FaultInjectionListener.InjectedFault):
+            trainer.fit(factory, epochs=1)
+
+
 class TestFailureDetection:
     def test_heartbeat_and_stall_detection(self, tmp_path):
         hb_dir = tmp_path
@@ -347,6 +401,50 @@ class TestFailureDetection:
             assert det.dead_workers() == ["w1", "w2"]
         finally:
             alive.stop()
+
+    def test_heartbeat_survives_transient_oserror(self, tmp_path):
+        """beat() failures (disk full, NFS blip) must not kill the loop — a
+        dead heartbeat thread reads as a dead WORKER to every observer. The
+        loop warns after WARN_AFTER_FAILURES consecutive misses and clears
+        the streak on the next success."""
+        import threading
+
+        hb = Heartbeat(str(tmp_path / "w.heartbeat"), interval=0.005)
+        real_beat = hb.beat
+        failing = threading.Event()
+
+        def flaky_beat():
+            if failing.is_set():
+                raise OSError("disk full")
+            real_beat()
+
+        hb.beat = flaky_beat
+        hb.start()  # initial beat succeeds (fail-fast contract unchanged)
+        try:
+            failing.set()
+            deadline = time.time() + 10
+            while time.time() < deadline and not hb._warned:
+                time.sleep(0.01)
+            assert hb._warned
+            assert hb.consecutive_failures >= Heartbeat.WARN_AFTER_FAILURES
+            assert hb._thread.is_alive()  # still beating, not dead
+            failing.clear()
+            deadline = time.time() + 10
+            while time.time() < deadline and hb.consecutive_failures:
+                time.sleep(0.01)
+            assert hb.consecutive_failures == 0  # success clears the streak
+            assert not hb._warned
+            assert hb._thread.is_alive()
+        finally:
+            hb.stop()
+
+    def test_heartbeat_initial_beat_still_fails_fast(self, tmp_path):
+        """start() keeps raising on an unwritable path: a worker that can
+        NEVER heartbeat should fail at startup, not spin silently."""
+        hb = Heartbeat(str(tmp_path / "no" / "such" / "dir" / "w.heartbeat"),
+                       interval=0.01)
+        with pytest.raises(OSError):
+            hb.start()
 
     def test_wedged_worker_ages_out(self, tmp_path):
         hb = Heartbeat(str(tmp_path / "w.heartbeat"), interval=60)

@@ -120,6 +120,66 @@ class TestLifecycle:
         inf.close()
         inf.close()
 
+    def test_close_fails_requests_queued_behind_sentinel(self):
+        """Requests a racing submit() slipped into the queue behind the
+        shutdown sentinel must be FAILED by close(), never left as futures
+        nobody will ever resolve. Staged deterministically: a pre-finished
+        dummy worker thread stands in for a coalescer that has already
+        exited at the sentinel."""
+        import queue
+        import threading
+
+        from deeplearning4j_tpu.parallel import inference as inf_mod
+
+        inf = ParallelInference(_mln(), workers=8)
+        dummy = threading.Thread(target=lambda: None)
+        dummy.start()
+        dummy.join()
+        inf._threads = [dummy]
+        inf._submit_q = queue.Queue()
+        inf._inflight_q = queue.Queue(maxsize=inf.inflight)
+        reqs = [inf_mod._Request(_features(1, seed=i), None)
+                for i in range(3)]
+        for r in reqs:
+            inf._submit_q.put(r)
+        inf.close()
+        for r in reqs:
+            with pytest.raises(RuntimeError, match="closed"):
+                r.future.result(timeout=5)
+        assert inf._submit_q.empty()
+
+    def test_submit_racing_close_resolves_future(self):
+        """A submit that passes the closed check just before close() lands
+        still gets a resolved (failed) future instead of hanging forever."""
+        import queue
+        import threading
+
+        from deeplearning4j_tpu.parallel import inference as inf_mod
+
+        inf = ParallelInference(_mln(), workers=8)
+        dummy = threading.Thread(target=lambda: None)
+        dummy.start()
+        dummy.join()
+        inf._threads = [dummy]
+        inf._submit_q = queue.Queue()
+        inf._inflight_q = queue.Queue(maxsize=inf.inflight)
+        orig_put = inf._submit_q.put
+
+        def put_then_close(item, *a, **kw):
+            orig_put(item, *a, **kw)
+            # close() lands exactly between this submit's enqueue and its
+            # post-enqueue closed re-check (the sentinel's own put recurses
+            # here; only the first real request triggers the close)
+            if item is not inf_mod._SHUTDOWN and not inf._closed:
+                inf.close()
+
+        inf._submit_q.put = put_then_close
+        fut = inf.submit(_features(1))
+        with pytest.raises(RuntimeError, match="closed"):
+            fut.result(timeout=5)
+        with pytest.raises(RuntimeError):
+            inf.submit(_features(1))  # and the server stays closed
+
     def test_single_example_promoted_to_batch(self):
         """A 1-D feature vector is treated as a 1-row batch."""
         net = _mln()
