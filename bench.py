@@ -523,6 +523,74 @@ def bench_inference_serve(n_requests: int = 256, max_batch: int = 64,
     }
 
 
+def bench_serve_chaos(n_requests: int = 256, max_batch: int = 64,
+                      max_wait_ms: float = 2.0,
+                      transient_rate: float = 0.10):
+    """The serving path under fault injection: the ``inference_serve``
+    workload with a ``ChaosPolicy`` failing ``transient_rate`` of
+    dispatches transiently. Measures what resilience costs AND proves the
+    zero-loss contract at bench scale — every future must resolve or fail
+    typed. Reports req/s, p50/p99 latency over SUCCESSFUL requests
+    (retried requests pay their backoffs in the tail), and the fraction
+    that still failed typed once the retry budget was spent."""
+    from deeplearning4j_tpu.models import LeNet
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+    from deeplearning4j_tpu.parallel.resilience import (ChaosPolicy,
+                                                        ResilienceError,
+                                                        RetryPolicy)
+
+    rs = np.random.RandomState(3)
+    xs = rs.randn(n_requests, 1, 28, 28, 1).astype(np.float32)
+    net = LeNet(num_labels=10).init()
+    chaos = ChaosPolicy(seed=7, transient_rate=transient_rate)
+    retry = RetryPolicy(max_attempts=4, base_s=1e-4, cap_s=2e-3, seed=0)
+    with ParallelInference(net, max_batch=max_batch,
+                           max_wait_ms=max_wait_ms,
+                           max_pending=2 * n_requests, retry=retry,
+                           chaos=chaos) as inf:
+        inf.submit(xs[0]).result(timeout=120)  # compile warm (1-row bucket)
+        inf.output(xs[:max_batch, 0])          # warm the full-batch bucket
+        chaos.injected_transient = 0           # don't count warmup faults
+        done_at = [None] * n_requests
+        t_submit = [None] * n_requests
+
+        def make_cb(i):
+            def cb(_fut):
+                done_at[i] = time.perf_counter()
+            return cb
+
+        t0 = time.perf_counter()
+        futs = []
+        for i in range(n_requests):
+            t_submit[i] = time.perf_counter()
+            f = inf.submit(xs[i])
+            f.add_done_callback(make_cb(i))
+            futs.append(f)
+        ok, failed_typed = [], 0
+        for i, f in enumerate(futs):
+            try:
+                f.result(timeout=120)
+                ok.append(i)
+            except ResilienceError:
+                failed_typed += 1
+        total = time.perf_counter() - t0
+        st = inf.stats()
+    lost = n_requests - len(ok) - failed_typed
+    if lost:  # the zero-loss contract is the point of the metric
+        raise RuntimeError(f"{lost} futures neither resolved nor failed "
+                           "typed under chaos")
+    lat_ms = sorted((done_at[i] - t_submit[i]) * 1e3 for i in ok)
+    return {
+        "serve_chaos_req_s": _sane("serve_chaos_req_s",
+                                   n_requests / total),
+        "serve_chaos_p50_ms": lat_ms[len(lat_ms) // 2],
+        "serve_chaos_p99_ms": lat_ms[int(len(lat_ms) * 0.99)],
+        "serve_chaos_typed_failure_frac": failed_typed / n_requests,
+        "serve_chaos_retries": float(st["retried"]),
+        "serve_chaos_injected_faults": float(chaos.injected_transient),
+    }
+
+
 def bench_word2vec(n_sentences: int = 50000, epochs: int = 1):
     """SkipGram words/s on a synthetic 1M-word corpus, 30k vocab (BASELINE
     config #4; corpus sized so fixed host/dispatch overheads are amortised
@@ -636,6 +704,7 @@ SANITY_CEILING = {
     "guard_on_img_s": 1e8,
     "guard_off_img_s": 1e8,
     "inference_serve_req_s": 1e8,
+    "serve_chaos_req_s": 1e8,
     "vgg16_bf16_img_s": 1e5,
     "textgen_lstm_tokens_s": 1e9,
     "transformer_lm_tokens_s": 1e9,
@@ -672,6 +741,12 @@ METRIC_UNIT = {
     "inference_serve_p50_ms": "ms",
     "inference_serve_p99_ms": "ms",
     "inference_serve_dispatches": "",
+    "serve_chaos_req_s": "req/s",
+    "serve_chaos_p50_ms": "ms",
+    "serve_chaos_p99_ms": "ms",
+    "serve_chaos_typed_failure_frac": "",
+    "serve_chaos_retries": "",
+    "serve_chaos_injected_faults": "",
     "vgg16_bf16_img_s": "img/s",
     "textgen_lstm_tokens_s": "tokens/s",
     "transformer_lm_tokens_s": "tokens/s",
@@ -899,7 +974,7 @@ def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     valid = ("all", "resnet50", "vgg16", "lenet", "lstm", "transformer",
              "word2vec", "doc2vec", "attention", "fit_e2e", "eval_e2e",
-             "guard_overhead", "inference_serve")
+             "guard_overhead", "inference_serve", "serve_chaos")
     if which not in valid:
         sys.exit(f"Unknown model '{which}'; choose one of {valid}")
     # persistent XLA compile cache: repeated bench runs skip the
@@ -934,6 +1009,9 @@ def main():
     if which in ("all", "inference_serve"):
         _sub_metric(extras, "inference_serve", bench_inference_serve)
         headline and headline.sample("post-inference-serve")
+    if which in ("all", "serve_chaos"):
+        _sub_metric(extras, "serve_chaos", bench_serve_chaos)
+        headline and headline.sample("post-serve-chaos")
     if which in ("all", "vgg16"):
         _sub_metric(extras, "vgg16_bf16_img_s", bench_vgg16, digits=2)
         if extras.get("vgg16_bf16_img_s"):

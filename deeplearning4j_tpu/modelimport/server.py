@@ -12,8 +12,19 @@ an HTTP entry point serves any client), batches are ``.npz`` files with
 - POST /import   {"path": "model.h5"}                   -> {"model": id}
 - POST /fit      {"model": id, "batches": [paths], "epochs": n}
 - POST /evaluate {"model": id, "batches": [paths]}      -> {"accuracy": ..}
-- POST /predict  {"model": id, "features": [[..], ..]}  -> {"output": ..}
+- POST /predict  {"model": id, "features": [[..], ..],
+                  "deadline_s": 2.0}                    -> {"output": ..}
 - GET  /models                                          -> {"models": [..]}
+- GET  /stats                                           -> serving counters
+
+The serving path degrades typed instead of failing open
+(parallel/resilience.py): /predict sheds load with 429 past the
+``max_pending`` admission watermark, fast-fails 503 while the circuit
+breaker is open, 504s requests whose ``deadline_s`` budget ran out, and
+retries transient dispatch faults with backoff. Malformed JSON, unknown
+model ids, and bodies beyond ``max_body_bytes`` return structured 4xx
+JSON errors ({"error": ..., "type": ...}) — never a traceback-driven 500
+or unbounded buffering.
 """
 
 from __future__ import annotations
@@ -21,18 +32,68 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.parallel.resilience import (AdmissionController,
+                                                    ChaosPolicy,
+                                                    CircuitBreaker,
+                                                    CircuitOpen, Deadline,
+                                                    DeadlineExceeded,
+                                                    RetryPolicy,
+                                                    ServerOverloaded,
+                                                    TransientDispatchError)
+
+
+class UnknownModelError(KeyError):
+    """Request named a model id this server never imported (HTTP 404 —
+    distinct from a bare KeyError, which means a missing request field
+    and maps to 400)."""
+
+
+#: error type -> HTTP status for the typed serving taxonomy
+_STATUS = {
+    ServerOverloaded: 429,
+    CircuitOpen: 503,
+    TransientDispatchError: 503,  # retry budget spent on transient faults
+    DeadlineExceeded: 504,
+}
+
 
 class KerasBackendServer:
-    def __init__(self, port: int = 0):
+    def __init__(self, port: int = 0, *, max_body_bytes: int = 64 << 20,
+                 max_pending: int = 64,
+                 request_deadline_s: Optional[float] = None,
+                 retry: Optional[RetryPolicy] = None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 chaos: Optional[ChaosPolicy] = None):
+        """Resilience knobs mirror ``ParallelInference``: ``max_pending``
+        bounds concurrent in-flight requests (beyond it /predict returns
+        429 immediately), ``request_deadline_s`` is the default /predict
+        budget (per-request ``deadline_s`` in the JSON body overrides; None
+        = unbounded), ``retry``/``breaker`` guard the model dispatch, and
+        ``chaos`` injects faults into it — test/bench only, default off.
+        ``max_body_bytes`` caps request bodies (413 beyond it; the body
+        is discarded unbuffered, never parsed)."""
         self._port = port
         self._models: dict = {}
         self._next_id = 0
         self._lock = threading.Lock()
         self._httpd = None
         self._thread = None
+        self.max_body_bytes = int(max_body_bytes)
+        self.request_deadline_s = request_deadline_s
+        self.admission = AdmissionController(max_pending)
+        self.retry = retry if retry is not None else RetryPolicy()
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
+        self._chaos = chaos
+        self._stats_lock = threading.Lock()
+        self._retried = 0
+        self._expired = 0
+        self._rejected_circuit = 0
+        self._completed = 0
+        self._failed = 0
 
     @property
     def port(self) -> int:
@@ -53,7 +114,7 @@ class KerasBackendServer:
     def _net(self, mid: str):
         net = self._models.get(mid)
         if net is None:
-            raise KeyError(f"unknown model '{mid}'")
+            raise UnknownModelError(f"unknown model '{mid}'")
         return net
 
     @staticmethod
@@ -89,9 +150,61 @@ class KerasBackendServer:
                 ev.eval(ds.labels, np.asarray(net.output(ds.features)))
             return {"accuracy": ev.accuracy(), "f1": ev.f1()}
 
-    def predict(self, mid: str, features) -> list:
-        with self._lock:
-            out = self._net(mid).output(np.asarray(features, np.float32))
+    def _count_retry(self, attempt, exc) -> None:
+        with self._stats_lock:
+            self._retried += 1
+
+    def _check_deadline(self, deadline: Optional[Deadline], stage: str):
+        if deadline is not None and deadline.expired():
+            with self._stats_lock:
+                self._expired += 1
+            raise DeadlineExceeded(
+                f"request budget exhausted {stage} "
+                f"({-deadline.remaining() * 1e3:.1f} ms over)")
+
+    def predict(self, mid: str, features,
+                deadline_s: Optional[float] = None) -> list:
+        """The guarded serving entry: admission -> breaker gate -> model
+        lock -> dispatch under retry, with the deadline re-checked at each
+        stage boundary so a request whose budget died waiting never costs
+        a device program."""
+        budget = deadline_s if deadline_s is not None \
+            else self.request_deadline_s
+        deadline = None if budget is None else Deadline(budget)
+        if not self.breaker.allow():
+            with self._stats_lock:
+                self._rejected_circuit += 1
+            raise CircuitOpen("circuit breaker is open: recent dispatches "
+                              "failed above threshold")
+        self.admission.acquire()  # raises ServerOverloaded at watermark
+        try:
+            with self._lock:
+                # the model-lock wait can eat the whole budget under load
+                self._check_deadline(deadline, "waiting for the model lock")
+                net = self._net(mid)
+                x = np.asarray(features, np.float32)
+                dispatch = (self._chaos.wrap(net.output)
+                            if self._chaos is not None else net.output)
+
+                def attempt():
+                    try:
+                        result = dispatch(x)
+                    except Exception:
+                        self.breaker.record_failure()
+                        raise
+                    self.breaker.record_success()
+                    return result
+
+                out = self.retry.call(attempt, deadline=deadline,
+                                      on_retry=self._count_retry)
+            with self._stats_lock:
+                self._completed += 1
+        except Exception:
+            with self._stats_lock:
+                self._failed += 1
+            raise
+        finally:
+            self.admission.release()
         if isinstance(out, (list, tuple)):
             out = out[0]
         return np.asarray(out).tolist()
@@ -99,6 +212,20 @@ class KerasBackendServer:
     def list_models(self) -> list:
         with self._lock:
             return sorted(self._models)
+
+    def stats(self) -> dict:
+        """Per-server serving counters (the /stats endpoint body): the
+        observable surface for the UI, bench, and ops."""
+        with self._stats_lock:
+            out = {"retried": self._retried, "expired": self._expired,
+                   "rejected_circuit": self._rejected_circuit,
+                   "completed": self._completed, "failed": self._failed}
+        out.update(accepted=self.admission.accepted,
+                   rejected=self.admission.rejected,
+                   pending=self.admission.pending,
+                   breaker_state=self.breaker.state,
+                   models=len(self._models))
+        return out
 
     # ----------------------------------------------------------- lifecycle
     def start(self) -> int:
@@ -116,16 +243,46 @@ class KerasBackendServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _error(self, status, message, err_type):
+                self._json({"error": message, "type": err_type}, status)
+
             def do_GET(self):
                 if self.path == "/models":
                     self._json({"models": server.list_models()})
+                elif self.path == "/stats":
+                    self._json(server.stats())
                 else:
-                    self._json({"error": "not found"}, 404)
+                    self._error(404, "not found", "NotFound")
 
             def do_POST(self):
-                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                except (TypeError, ValueError):
+                    return self._error(400, "missing or malformed "
+                                       "Content-Length", "BadRequest")
+                if n > server.max_body_bytes:
+                    # the cap bounds MEMORY, not the wire: the body is
+                    # discarded in fixed-size chunks (never buffered) so
+                    # the client — still blocked in send — can finish and
+                    # read the 413 instead of dying on a broken pipe
+                    left = n
+                    while left > 0:
+                        chunk = self.rfile.read(min(left, 1 << 16))
+                        if not chunk:
+                            break
+                        left -= len(chunk)
+                    return self._error(
+                        413, f"request body of {n} bytes exceeds "
+                        f"max_body_bytes={server.max_body_bytes}",
+                        "BodyTooLarge")
                 try:
                     req = json.loads(self.rfile.read(n))
+                    if not isinstance(req, dict):
+                        raise ValueError("JSON body must be an object")
+                except (ValueError, UnicodeDecodeError) as e:
+                    return self._error(400, f"malformed JSON body: {e}",
+                                       "BadRequest")
+                try:
                     if self.path == "/import":
                         self._json({"model":
                                     server.import_model(req["path"])})
@@ -136,13 +293,26 @@ class KerasBackendServer:
                         self._json(server.evaluate(req["model"],
                                                    req["batches"]))
                     elif self.path == "/predict":
-                        self._json({"output":
-                                    server.predict(req["model"],
-                                                   req["features"])})
+                        self._json({"output": server.predict(
+                            req["model"], req["features"],
+                            req.get("deadline_s"))})
                     else:
-                        self._json({"error": "not found"}, 404)
-                except Exception as e:  # noqa: BLE001 — report to client
-                    self._json({"error": f"{type(e).__name__}: {e}"}, 400)
+                        self._error(404, "not found", "NotFound")
+                except UnknownModelError as e:
+                    self._error(404, str(e.args[0] if e.args else e),
+                                type(e).__name__)
+                except tuple(_STATUS) as e:
+                    status = next(s for c, s in _STATUS.items()
+                                  if isinstance(e, c))
+                    self._error(status, str(e), type(e).__name__)
+                except (KeyError, TypeError, ValueError, OSError) as e:
+                    # bad request shape / unreadable batch paths
+                    self._error(400, f"{type(e).__name__}: {e}",
+                                "BadRequest")
+                except Exception as e:  # noqa: BLE001 — structured, not a
+                    # traceback-driven blank 500
+                    self._error(500, f"{type(e).__name__}: {e}",
+                                "InternalError")
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", self._port), Handler)
         self._thread = threading.Thread(target=self._httpd.serve_forever,

@@ -18,6 +18,19 @@ from deeplearning4j_tpu.parallel.model_sharding import (
     shard_network,
 )
 from deeplearning4j_tpu.parallel.inference import ParallelInference
+from deeplearning4j_tpu.parallel.resilience import (
+    AdmissionController,
+    ChaosPolicy,
+    CircuitBreaker,
+    CircuitOpen,
+    Deadline,
+    DeadlineExceeded,
+    ResilienceError,
+    RetryPolicy,
+    ServerOverloaded,
+    StreamStalled,
+    TransientDispatchError,
+)
 from deeplearning4j_tpu.parallel.evaluation import evaluate_on_mesh
 from deeplearning4j_tpu.parallel.mesh import data_mesh
 from deeplearning4j_tpu.parallel.spark import (

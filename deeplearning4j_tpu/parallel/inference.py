@@ -21,12 +21,25 @@ Both entries share one jit cache, BUCKETED on the batch dim (padded to the
 next power of two, rounded to a worker multiple — optimize/bucketing.py) so
 arbitrary request sizes compile O(log max_batch) programs, and LRU-bounded
 so a long-lived server cannot grow it without bound.
+
+The serving path is guarded end to end (parallel/resilience.py — the
+serving counterpart of optimize/health.py): every ``submit`` passes
+admission control (beyond ``max_pending`` in-flight requests, reject with
+``ServerOverloaded`` instead of queueing unboundedly) and the circuit
+breaker's gate (``CircuitOpen`` fast-fail while dispatches are failing at
+rate); a ``deadline_s`` budget travels with the request and expires it in
+the coalescer BEFORE padding/dispatch (``DeadlineExceeded`` — a device
+program is never wasted on a dead request); dispatch runs under
+``RetryPolicy`` backoff for ``TransientDispatchError``. The invariant: an
+admitted request's future always resolves — with rows, or with a typed
+error — never hangs.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+import time
 from concurrent.futures import Future
 from typing import Optional
 
@@ -38,21 +51,29 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from deeplearning4j_tpu.optimize.bucketing import (BoundedCache, bucket_rows,
                                                    pad_rows)
 from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, data_mesh
+from deeplearning4j_tpu.parallel.resilience import (AdmissionController,
+                                                    ChaosPolicy,
+                                                    CircuitBreaker,
+                                                    CircuitOpen, Deadline,
+                                                    DeadlineExceeded,
+                                                    RetryPolicy)
 
 _SHUTDOWN = object()
 
 
 class _Request:
     """One submitted observable: input rows + the future its slice lands in
-    (the reference's InferenceObservable, minus the wait/notify)."""
+    (the reference's InferenceObservable, minus the wait/notify), plus the
+    request's deadline (None = unbounded)."""
 
-    __slots__ = ("x", "mask", "n", "future")
+    __slots__ = ("x", "mask", "n", "future", "deadline")
 
-    def __init__(self, x, mask):
+    def __init__(self, x, mask, deadline: Optional[Deadline] = None):
         self.x = x
         self.mask = mask
         self.n = x.shape[0]
         self.future: Future = Future()
+        self.deadline = deadline
 
     def signature(self):
         return (self.x.shape[1:], self.mask is not None)
@@ -61,11 +82,25 @@ class _Request:
 class ParallelInference:
     def __init__(self, net, mesh: Optional[Mesh] = None,
                  workers: Optional[int] = None, *, max_batch: int = 64,
-                 max_wait_ms: float = 3.0, inflight: int = 2):
+                 max_wait_ms: float = 3.0, inflight: int = 2,
+                 max_pending: int = 256,
+                 retry: Optional[RetryPolicy] = None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 chaos: Optional[ChaosPolicy] = None):
         """``max_batch``/``max_wait_ms`` bound the coalescer: a batch is
         dispatched when it reaches ``max_batch`` rows or ``max_wait_ms``
         after its first request, whichever comes first. ``inflight`` bounds
-        the dispatch pipeline (assembled-but-unfetched batches)."""
+        the dispatch pipeline (assembled-but-unfetched batches).
+
+        Resilience knobs: ``max_pending`` is the admission high-watermark
+        (requests beyond it are rejected with ``ServerOverloaded`` instead
+        of queueing); ``retry`` retries ``TransientDispatchError`` with
+        backoff (default ``RetryPolicy()``, pass a policy with
+        ``max_attempts=1`` to disable); ``breaker`` fast-fails submits
+        with ``CircuitOpen`` while dispatches fail at rate (default
+        ``CircuitBreaker()``, pass ``breaker=False`` to disable); ``chaos``
+        wraps the dispatch callable with a fault injector — test/bench
+        only, default off."""
         self.net = net
         self.mesh = mesh if mesh is not None else data_mesh(workers)
         self.workers = self.mesh.devices.size
@@ -76,6 +111,21 @@ class ParallelInference:
         #: device program calls issued (coalescing efficiency metric: N
         #: submits completing in 1 dispatch is the point of the batcher)
         self.dispatch_count = 0
+        self.admission = AdmissionController(max_pending)
+        self.retry = retry if retry is not None else RetryPolicy()
+        self.breaker = (None if breaker is False
+                        else breaker if breaker is not None
+                        else CircuitBreaker())
+        self._dispatch = (chaos.wrap(self._dispatch_fwd) if chaos is not None
+                          else self._dispatch_fwd)
+        self._stats_lock = threading.Lock()
+        self._rejected_circuit = 0
+        self._retried = 0
+        self._expired = 0
+        self._completed = 0
+        self._failed = 0
+        self._drain_cv = threading.Condition()
+        self._draining = False
         self._submit_q: Optional[queue.Queue] = None
         self._inflight_q: Optional[queue.Queue] = None
         self._threads: list = []
@@ -134,17 +184,40 @@ class ParallelInference:
         return np.asarray(out)[:x.shape[0]]
 
     # --------------------------------------------------------- async entry
-    def submit(self, x, mask=None) -> Future:
+    def submit(self, x, mask=None, *,
+               deadline_s: Optional[float] = None) -> Future:
         """Async inference: returns a Future of this request's output rows.
         Requests submitted concurrently are coalesced into one device batch
         (the reference's BatchedInferenceObservable); each future resolves
-        to exactly its own rows, in row order."""
-        if self._closed:
-            raise RuntimeError("ParallelInference is closed")
+        to exactly its own rows, in row order.
+
+        ``deadline_s`` is the request's time budget from this call: a
+        request still undispatched when it expires fails with
+        ``DeadlineExceeded`` (checked in the coalescer BEFORE padding and
+        dispatch, so no device program is spent on it). Raises
+        ``ServerOverloaded`` when ``max_pending`` requests are in flight
+        and ``CircuitOpen`` while the breaker is open — both immediately,
+        never by blocking the caller."""
+        if self._closed or self._draining:
+            raise RuntimeError("ParallelInference is closed"
+                               if self._closed else
+                               "ParallelInference is draining")
+        if self.breaker is not None and not self.breaker.allow():
+            with self._stats_lock:
+                self._rejected_circuit += 1
+            raise CircuitOpen("circuit breaker is open: recent dispatches "
+                              "failed above threshold")
+        self.admission.acquire()  # raises ServerOverloaded at watermark
         x = np.asarray(x)
         if x.ndim < 2:
             x = x[None]  # single example -> 1-row batch
-        req = _Request(x, None if mask is None else np.asarray(mask))
+        req = _Request(x, None if mask is None else np.asarray(mask),
+                       None if deadline_s is None else Deadline(deadline_s))
+        # the done-callback is the single release point for admission and
+        # the completion counters: it fires on EVERY resolution path
+        # (result, typed failure, shutdown drain), so pending can never
+        # leak no matter which thread resolves the future
+        req.future.add_done_callback(self._on_done)
         self._ensure_workers()
         self._submit_q.put(req)
         if self._closed and not req.future.done():
@@ -156,6 +229,32 @@ class ParallelInference:
             self._fail(req.future,
                        RuntimeError("ParallelInference is closed"))
         return req.future
+
+    def _on_done(self, fut: Future) -> None:
+        self.admission.release()
+        with self._stats_lock:
+            if fut.exception() is None:
+                self._completed += 1
+            else:
+                self._failed += 1
+        with self._drain_cv:
+            self._drain_cv.notify_all()
+
+    def stats(self) -> dict:
+        """Serving counters (monotone except pending/breaker_state): the
+        observable surface the UI, bench, and ops read."""
+        with self._stats_lock:
+            out = {"retried": self._retried, "expired": self._expired,
+                   "rejected_circuit": self._rejected_circuit,
+                   "completed": self._completed, "failed": self._failed}
+        out.update(
+            accepted=self.admission.accepted,
+            rejected=self.admission.rejected,
+            pending=self.admission.pending,
+            dispatches=self.dispatch_count,
+            breaker_state=(self.breaker.state if self.breaker is not None
+                           else "disabled"))
+        return out
 
     @staticmethod
     def _fail(future: Future, exc: Exception) -> None:
@@ -184,9 +283,28 @@ class ParallelInference:
             coalescer.start()
             completer.start()
 
-    def _coalesce_loop(self):
-        import time
+    def _expire_if_dead(self, req) -> bool:
+        """Fail an already-expired request with DeadlineExceeded (True),
+        or report it still live (False). Every coalescer touchpoint runs
+        this BEFORE spending work on the request."""
+        if req.deadline is None or not req.deadline.expired():
+            return False
+        with self._stats_lock:
+            self._expired += 1
+        self._fail(req.future, DeadlineExceeded(
+            f"request expired {-req.deadline.remaining() * 1e3:.1f} ms "
+            "before dispatch"))
+        return True
 
+    @staticmethod
+    def _flush_by(d) -> float:
+        """Latest instant the assembly window may run to for a member with
+        deadline ``d``: a quarter of the member's remaining budget is
+        reserved for the dispatch itself, so flushing at the window edge
+        still lands BEFORE expiry instead of exactly on it."""
+        return d.expires_at - 0.25 * max(0.0, d.remaining())
+
+    def _coalesce_loop(self):
         q = self._submit_q
         head = None
         while True:
@@ -195,10 +313,17 @@ class ParallelInference:
             if first is _SHUTDOWN:
                 self._inflight_q.put(_SHUTDOWN)
                 return
+            if self._expire_if_dead(first):
+                continue
             batch = [first]
             rows = first.n
             sig = first.signature()
             deadline = time.monotonic() + self.max_wait_s
+            if first.deadline is not None:
+                # remaining-time propagation: a member with less budget
+                # than the coalesce window flushes the batch early, so it
+                # is dispatched before it expires rather than after
+                deadline = min(deadline, self._flush_by(first.deadline))
             while rows < self.max_batch:
                 wait = deadline - time.monotonic()
                 if wait <= 0:
@@ -210,11 +335,38 @@ class ParallelInference:
                 if nxt is _SHUTDOWN or nxt.signature() != sig:
                     head = nxt  # flush now; the mismatch starts its own batch
                     break
+                if self._expire_if_dead(nxt):
+                    continue
                 batch.append(nxt)
                 rows += nxt.n
+                if nxt.deadline is not None:
+                    deadline = min(deadline, self._flush_by(nxt.deadline))
             self._dispatch_batch(batch)
 
+    def _count_retry(self, attempt, exc) -> None:
+        with self._stats_lock:
+            self._retried += 1
+
     def _dispatch_batch(self, batch):
+        # last expiry gate: members that died waiting in the assembly
+        # window fail typed here, before any padding or device work
+        batch = [r for r in batch if not self._expire_if_dead(r)]
+        if not batch:
+            return
+        earliest = min((r.deadline for r in batch if r.deadline is not None),
+                       key=lambda d: d.expires_at, default=None)
+
+        def attempt():
+            try:
+                out = self._dispatch(x, mask)  # async dispatch, no fetch
+            except Exception:
+                if self.breaker is not None:
+                    self.breaker.record_failure()
+                raise
+            if self.breaker is not None:
+                self.breaker.record_success()
+            return out
+
         try:
             x = (batch[0].x if len(batch) == 1
                  else np.concatenate([r.x for r in batch]))
@@ -222,10 +374,14 @@ class ParallelInference:
             if batch[0].mask is not None:
                 mask = (batch[0].mask if len(batch) == 1
                         else np.concatenate([r.mask for r in batch]))
-            out = self._dispatch_fwd(x, mask)  # async dispatch, no fetch
+            out = self.retry.call(attempt, deadline=earliest,
+                                  on_retry=self._count_retry)
         except Exception as e:  # noqa: BLE001 — surface on every future
             for r in batch:
-                self._fail(r.future, e)
+                # a member whose budget died during the retry storm fails
+                # as DeadlineExceeded; the rest carry the original error
+                if not self._expire_if_dead(r):
+                    self._fail(r.future, e)
             return
         # blocks when `inflight` batches are already pending — bounded
         # pipeline: device compute overlaps the NEXT batch's host assembly
@@ -252,11 +408,37 @@ class ParallelInference:
                 ofs += r.n
 
     # ------------------------------------------------------------ lifecycle
-    def close(self):
-        """Flush and stop the coalescer threads (idempotent). Pending
-        futures complete before the threads exit; requests that raced the
-        shutdown in behind the sentinel are FAILED with RuntimeError,
-        never left unresolved."""
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful drain: stop admitting new submits (they raise
+        RuntimeError) while every in-flight request runs to resolution.
+        Returns True once nothing is pending, False if ``timeout`` seconds
+        pass first (in-flight work keeps completing either way). The first
+        phase of ``close()``; also usable alone for zero-loss handoff
+        (drain, swap weights/process, resume)."""
+        self._draining = True
+        limit = None if timeout is None else time.monotonic() + timeout
+        with self._drain_cv:
+            while self.admission.pending > 0:
+                if not any(t.is_alive() for t in self._threads):
+                    # no worker will ever resolve the remainder (crashed
+                    # coalescer, or staged shutdown tests): close()'s
+                    # behind-sentinel queue drain owns those requests
+                    return False
+                wait = 0.2 if limit is None else min(
+                    0.2, limit - time.monotonic())
+                if wait <= 0:
+                    return False
+                self._drain_cv.wait(wait)  # chunked: re-checks liveness
+        return True
+
+    def close(self, timeout: float = 30.0):
+        """Drain (complete in-flight work, reject new submissions), then
+        flush and stop the coalescer threads (idempotent). Pending futures
+        complete before the threads exit; requests that raced the shutdown
+        in behind the sentinel are FAILED with RuntimeError, never left
+        unresolved."""
+        if not self._closed and self._threads:
+            self.drain(timeout)
         with self._lock:
             if self._closed:
                 return

@@ -1,0 +1,322 @@
+"""Serving-side resilience primitives: deadlines, admission control,
+retries, a circuit breaker, and a deterministic chaos injector.
+
+The serving counterpart of ``optimize/health.py``: training self-heals on
+device, and with this module the serving path (the coalescing
+``ParallelInference`` server and the ``KerasBackendServer`` HTTP frontend)
+degrades *typed and bounded* instead of failing open. The contract every
+component here enforces is the SRE one: a submitted request either
+resolves, or fails promptly with an error from the taxonomy below — it is
+never left pending forever, and an overloaded server sheds load instead of
+queueing it unboundedly.
+
+The reference stack has no analog (DL4J's ParallelInference blocks callers
+on an unbounded observable queue); the designs here are the standard
+model-server guardrails: decorrelated-jitter backoff (the AWS architecture
+blog variant), a closed -> open -> half-open breaker over a sliding outcome
+window, and high-watermark admission control.
+
+Everything in this module is host-side stdlib — no jax, no device state —
+so it is reusable by any serving surface (and importable by test harnesses
+without touching an accelerator).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional, Tuple
+
+from deeplearning4j_tpu.streaming.client import StreamStalled  # noqa: F401
+# StreamStalled lives with the streaming consumer (keeping streaming/
+# importable without this package) but belongs to this taxonomy: re-export
+# it so `resilience` names every typed serving failure.
+
+
+class ResilienceError(RuntimeError):
+    """Base of the typed serving-failure taxonomy. Every admitted request
+    either resolves or fails with one of these subclasses (or with the
+    original dispatch error once the retry budget is spent) — never
+    silently dropped, never left pending."""
+
+
+class DeadlineExceeded(ResilienceError):
+    """The request's time budget ran out before a result was produced.
+    HTTP mapping: 504."""
+
+
+class ServerOverloaded(ResilienceError):
+    """Admission control shed the request: the pending count was at the
+    high-watermark. Raised immediately at submit — the caller is told to
+    back off rather than being blocked behind an unbounded queue.
+    HTTP mapping: 429."""
+
+
+class CircuitOpen(ResilienceError):
+    """The circuit breaker is open: recent dispatches failed above the
+    threshold rate, so new work is fast-failed until a half-open probe
+    succeeds. HTTP mapping: 503."""
+
+
+class TransientDispatchError(ResilienceError):
+    """A dispatch failure worth retrying (device hiccup, transient
+    transport error). ``RetryPolicy`` retries exactly these; anything
+    else propagates on the first attempt. HTTP mapping: 503 (when the
+    retry budget is exhausted)."""
+
+
+class Deadline:
+    """Per-request time budget with remaining-time propagation.
+
+    Created once at admission; every later stage (queue pickup, batch
+    assembly, padding, each retry attempt) asks ``remaining()`` instead of
+    re-deriving its own budget, so the request's clock never resets as it
+    moves through the pipeline and an expired request is failed *before*
+    a device program is wasted on it."""
+
+    __slots__ = ("expires_at", "_clock")
+
+    def __init__(self, budget_s: float, *,
+                 clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self.expires_at = clock() + float(budget_s)
+
+    def remaining(self) -> float:
+        """Seconds left in the budget (<= 0 once expired)."""
+        return self.expires_at - self._clock()
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0
+
+
+class RetryPolicy:
+    """Capped exponential backoff with decorrelated jitter, retrying ONLY
+    transient errors.
+
+    ``sleep_{i+1} ~ U[base_s, 3 * sleep_i]`` capped at ``cap_s`` — the
+    decorrelated variant spreads concurrent retriers apart instead of
+    synchronizing them into retry storms. Deterministic under ``seed``;
+    ``sleep`` is injectable so tests run at full speed."""
+
+    def __init__(self, max_attempts: int = 3, base_s: float = 0.005,
+                 cap_s: float = 0.25,
+                 retry_on: Tuple[type, ...] = (TransientDispatchError,),
+                 seed: Optional[int] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.max_attempts = max(1, int(max_attempts))
+        self.base_s = float(base_s)
+        self.cap_s = float(cap_s)
+        self.retry_on = tuple(retry_on)
+        self._rng = random.Random(seed)
+        self._sleep = sleep
+        self._lock = threading.Lock()
+
+    def backoff_s(self, previous: float) -> float:
+        with self._lock:  # one rng shared by concurrent dispatch threads
+            return min(self.cap_s,
+                       self._rng.uniform(self.base_s,
+                                         max(self.base_s, 3.0 * previous)))
+
+    def call(self, fn: Callable, *args,
+             deadline: Optional[Deadline] = None,
+             on_retry: Optional[Callable[[int, Exception], None]] = None):
+        """Run ``fn(*args)`` retrying transient failures until the attempt
+        budget — or the request's deadline — runs out. A backoff that the
+        deadline cannot cover gives up immediately (re-raising the
+        transient error) instead of sleeping past the budget."""
+        delay = self.base_s
+        for attempt in range(1, self.max_attempts + 1):
+            try:
+                return fn(*args)
+            except self.retry_on:
+                if attempt >= self.max_attempts:
+                    raise
+                delay = self.backoff_s(delay)
+                if deadline is not None and deadline.remaining() <= delay:
+                    raise
+                if on_retry is not None:
+                    on_retry(attempt, None)
+                self._sleep(delay)
+
+
+class CircuitBreaker:
+    """closed -> open (failure rate over a sliding window crosses the
+    threshold) -> half-open probe after ``reset_timeout_s`` -> closed on
+    probe success, reopened on probe failure.
+
+    ``allow()`` is the admission-side gate (an open breaker fast-fails new
+    submits); ``record_success``/``record_failure`` are fed per dispatch
+    attempt. The clock is injectable so state transitions are testable
+    without real waiting."""
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+    def __init__(self, failure_threshold: float = 0.5, window: int = 16,
+                 min_calls: int = 8, reset_timeout_s: float = 5.0,
+                 half_open_probes: int = 1,
+                 clock: Callable[[], float] = time.monotonic):
+        self.failure_threshold = float(failure_threshold)
+        self.min_calls = max(1, int(min_calls))
+        self.reset_timeout_s = float(reset_timeout_s)
+        self.half_open_probes = max(1, int(half_open_probes))
+        self._clock = clock
+        self._outcomes: deque = deque(maxlen=max(1, int(window)))
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._opened_at = 0.0
+        self._probes = 0
+        self._half_open_at = 0.0
+        #: times the breaker tripped open (monotone counter, for stats())
+        self.open_count = 0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._current_state()
+
+    def _current_state(self) -> str:
+        # lock held; OPEN decays to HALF_OPEN once the reset timeout passes
+        now = self._clock()
+        if (self._state == self.OPEN
+                and now - self._opened_at >= self.reset_timeout_s):
+            self._state = self.HALF_OPEN
+            self._probes = 0
+            self._half_open_at = now
+        elif (self._state == self.HALF_OPEN
+                and now - self._half_open_at >= self.reset_timeout_s):
+            # probes that never reported an outcome (e.g. the probe request
+            # expired before dispatch) must not wedge the breaker in a
+            # probe-exhausted half-open state: replenish periodically
+            self._probes = 0
+            self._half_open_at = now
+        return self._state
+
+    def allow(self) -> bool:
+        """May new work enter? CLOSED: yes. OPEN: no (fast-fail).
+        HALF_OPEN: up to ``half_open_probes`` probes, then no."""
+        with self._lock:
+            st = self._current_state()
+            if st == self.CLOSED:
+                return True
+            if st == self.OPEN:
+                return False
+            if self._probes < self.half_open_probes:
+                self._probes += 1
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self._current_state() == self.HALF_OPEN:
+                # the probe came back healthy: close and start fresh
+                self._state = self.CLOSED
+                self._outcomes.clear()
+            else:
+                self._outcomes.append(True)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            if self._current_state() == self.HALF_OPEN:
+                self._trip()  # the probe failed: straight back to open
+                return
+            self._outcomes.append(False)
+            n = len(self._outcomes)
+            failures = n - sum(self._outcomes)
+            if n >= self.min_calls and failures / n >= self.failure_threshold:
+                self._trip()
+
+    def _trip(self) -> None:
+        # lock held
+        self._state = self.OPEN
+        self._opened_at = self._clock()
+        self._outcomes.clear()
+        self.open_count += 1
+
+
+class AdmissionController:
+    """High-watermark load shedding: beyond ``max_pending`` in-flight
+    requests, ``acquire()`` raises ``ServerOverloaded`` immediately
+    instead of blocking the caller. Also the server's accepted/rejected/
+    pending bookkeeping — release exactly once per acquire (the serving
+    layers do it from a future done-callback, which covers every
+    resolution path)."""
+
+    def __init__(self, max_pending: int = 256):
+        self.max_pending = max(1, int(max_pending))
+        self._lock = threading.Lock()
+        self.pending = 0
+        self.accepted = 0
+        self.rejected = 0
+
+    def acquire(self) -> None:
+        with self._lock:
+            if self.pending >= self.max_pending:
+                self.rejected += 1
+                raise ServerOverloaded(
+                    f"{self.pending} requests pending, at the "
+                    f"max_pending={self.max_pending} high-watermark")
+            self.pending += 1
+            self.accepted += 1
+
+    def release(self) -> None:
+        with self._lock:
+            self.pending -= 1
+
+
+class ChaosPolicy:
+    """Deterministic, seedable fault injector for tests and the chaos
+    bench — wraps a dispatch callable to inject latency, transient errors
+    (retryable), and hard errors, at independent per-call rates drawn from
+    one seeded rng. All rates default to 0 and nothing in the production
+    path constructs one: chaos only exists where a test or bench passes it
+    in explicitly."""
+
+    def __init__(self, seed: int = 0, transient_rate: float = 0.0,
+                 hard_rate: float = 0.0, latency_s: float = 0.0,
+                 latency_rate: float = 0.0,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.transient_rate = float(transient_rate)
+        self.hard_rate = float(hard_rate)
+        self.latency_s = float(latency_s)
+        self.latency_rate = float(latency_rate)
+        self._sleep = sleep
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.injected_transient = 0
+        self.injected_hard = 0
+        self.injected_latency = 0
+
+    def wrap(self, fn: Callable) -> Callable:
+        """The chaotic twin of ``fn``: same signature, same result, but
+        each call may first sleep and/or raise per the configured rates."""
+
+        def chaotic(*args, **kwargs):
+            with self._lock:  # one rng, many dispatch threads
+                r_latency = self._rng.random()
+                r_error = self._rng.random()
+                inject_latency = (self.latency_rate
+                                  and r_latency < self.latency_rate)
+                inject_hard = self.hard_rate and r_error < self.hard_rate
+                inject_transient = (self.transient_rate and not inject_hard
+                                    and r_error < (self.hard_rate
+                                                   + self.transient_rate))
+                if inject_latency:
+                    self.injected_latency += 1
+                if inject_hard:
+                    self.injected_hard += 1
+                if inject_transient:
+                    self.injected_transient += 1
+            if inject_latency:
+                self._sleep(self.latency_s)
+            if inject_hard:
+                raise RuntimeError("chaos: injected hard fault")
+            if inject_transient:
+                raise TransientDispatchError("chaos: injected transient "
+                                             "fault")
+            return fn(*args, **kwargs)
+
+        return chaotic

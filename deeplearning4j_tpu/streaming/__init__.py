@@ -22,6 +22,7 @@ from deeplearning4j_tpu.streaming.client import (
     NDArrayConsumer,
     NDArrayPublisher,
     NDArrayRoute,
+    StreamStalled,
 )
 from deeplearning4j_tpu.streaming.serde import (
     dataset_from_bytes,
@@ -33,6 +34,7 @@ __all__ = [
     "NDArrayPublisher",
     "NDArrayConsumer",
     "NDArrayRoute",
+    "StreamStalled",
     "dataset_to_bytes",
     "dataset_from_bytes",
 ]

@@ -26,6 +26,7 @@ import queue
 import socket
 import struct
 import threading
+import time
 from typing import Optional
 
 _HDR = struct.Struct(">cH")
@@ -84,26 +85,42 @@ class _Subscriber:
         self.topic = topic
         self.q: queue.Queue = queue.Queue(maxsize=maxsize)
         self.alive = True
+        self.dropped = 0            # frames this subscriber never received
+        self.consecutive_drops = 0  # resets on every delivered frame
 
 
 class StreamingBroker:
     """Threaded topic broker. ``port=0`` picks a free port (see
     ``.port``). One writer thread per subscriber drains its bounded
-    queue; a publish blocks (backpressure) while ANY live subscriber's
-    queue is full — a slow consumer throttles the stream instead of
-    exhausting broker memory, the same role Kafka's bounded log +
-    consumer lag plays for the reference."""
+    queue; a publish backpressures (blocks up to ``publish_patience_s``)
+    while a live subscriber's queue is full — a slow consumer throttles
+    the stream instead of exhausting broker memory, the same role Kafka's
+    bounded log + consumer lag plays for the reference.
+
+    A subscriber that stays full PAST the patience window no longer stalls
+    every other subscriber silently: the frame is dropped *for that
+    subscriber only*, counted (``stats()``), and after ``drop_limit``
+    CONSECUTIVE drops the subscriber is disconnected (it can reconnect and
+    resubscribe) — the Kafka consumer-eviction analog. Set
+    ``publish_patience_s=None`` for the legacy block-forever backpressure
+    (no drops, no eviction)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 subscriber_buffer: int = 16):
+                 subscriber_buffer: int = 16, drop_limit: int = 8,
+                 publish_patience_s: Optional[float] = 0.5):
         self.host = host
         self.port = port
         self.subscriber_buffer = subscriber_buffer
+        self.drop_limit = max(1, int(drop_limit))
+        self.publish_patience_s = publish_patience_s
         self._subs: dict = {}          # topic -> [_Subscriber]
         self._lock = threading.Lock()
         self._server: Optional[socket.socket] = None
         self._threads: list = []
         self._stop = threading.Event()
+        self._frames_dropped = 0
+        self._subs_disconnected = 0
+        self._dropped_by_topic: dict = {}
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "StreamingBroker":
@@ -214,12 +231,67 @@ class StreamingBroker:
         with self._lock:
             subs = list(self._subs.get(topic, []))
         for s in subs:
-            while s.alive and not self._stop.is_set():
-                try:
-                    s.q.put((op, payload), timeout=0.2)  # backpressure
-                    break
-                except queue.Full:
-                    continue
+            self._offer(s, op, payload)
+
+    def _offer(self, s: _Subscriber, op: bytes, payload: bytes):
+        """Deliver one frame to one subscriber with bounded backpressure:
+        block up to ``publish_patience_s`` (forever when None), then drop
+        the frame FOR THIS SUBSCRIBER, count it, and evict the subscriber
+        after ``drop_limit`` consecutive drops."""
+        limit = (None if self.publish_patience_s is None
+                 else time.monotonic() + self.publish_patience_s)
+        while s.alive and not self._stop.is_set():
+            wait = 0.2 if limit is None else min(
+                0.2, limit - time.monotonic())
+            if wait <= 0:
+                break
+            try:
+                s.q.put((op, payload), timeout=wait)  # backpressure
+                s.consecutive_drops = 0
+                return
+            except queue.Full:
+                continue
+        if not s.alive or self._stop.is_set():
+            return
+        # the patience window closed with the queue still full: this frame
+        # is lost to this subscriber — counted, never silent
+        with self._lock:
+            s.dropped += 1
+            s.consecutive_drops += 1
+            self._frames_dropped += 1
+            self._dropped_by_topic[s.topic] = (
+                self._dropped_by_topic.get(s.topic, 0) + 1)
+            evict = s.consecutive_drops >= self.drop_limit
+        if evict:
+            self._disconnect(s)
+
+    def _disconnect(self, s: _Subscriber):
+        """Evict a persistently-slow subscriber (it can reconnect): its
+        writer thread exits on ``alive=False``, the socket close tells the
+        consumer immediately (EOF) rather than leaving it waiting on
+        frames that will never come."""
+        s.alive = False
+        with self._lock:
+            ss = self._subs.get(s.topic, [])
+            if s in ss:
+                ss.remove(s)
+            self._subs_disconnected += 1
+        try:
+            s.sock.close()
+        except OSError:
+            pass
+
+    def stats(self) -> dict:
+        """Fan-out health counters: live subscriber count, frames dropped
+        for slow subscribers (total and per topic), and slow-subscriber
+        evictions."""
+        with self._lock:
+            return {
+                "subscribers": sum(len(v) for v in self._subs.values()),
+                "frames_dropped": self._frames_dropped,
+                "subscribers_disconnected": self._subs_disconnected,
+                "dropped_by_topic": dict(self._dropped_by_topic),
+            }
 
 
 def main(argv=None):
@@ -231,8 +303,18 @@ def main(argv=None):
     ap.add_argument("--port", type=int, default=9092)
     ap.add_argument("--buffer", type=int, default=16,
                     help="per-subscriber frame buffer (backpressure bound)")
+    ap.add_argument("--drop-limit", type=int, default=8,
+                    help="consecutive dropped frames before a slow "
+                         "subscriber is disconnected")
+    ap.add_argument("--patience", type=float, default=0.5,
+                    help="seconds a publish backpressures on a full "
+                         "subscriber queue before dropping the frame "
+                         "(<=0: block forever, legacy behavior)")
     args = ap.parse_args(argv)
-    broker = StreamingBroker(args.host, args.port, args.buffer).start()
+    broker = StreamingBroker(
+        args.host, args.port, args.buffer, drop_limit=args.drop_limit,
+        publish_patience_s=None if args.patience <= 0 else args.patience,
+    ).start()
     print(f"streaming broker listening on {broker.host}:{broker.port}",
           flush=True)
     try:

@@ -30,6 +30,14 @@ from deeplearning4j_tpu.streaming.serde import (
 )
 
 
+class StreamStalled(RuntimeError):
+    """A consumer saw no frame within its ``idle_timeout_s`` — the broker
+    or publisher is presumed dead/wedged. Raised instead of surfacing a
+    silent early end-of-stream to ``fit()``. Defined here (not in
+    parallel/resilience.py, which re-exports it into the serving error
+    taxonomy) so streaming stays importable without the parallel stack."""
+
+
 class NDArrayPublisher:
     """Publish DataSet minibatches to a broker topic
     (NDArrayPublisher.java analog; also usable as a context manager)."""
@@ -69,11 +77,20 @@ class NDArrayPublisher:
 
 class NDArrayConsumer:
     """Subscribe to a topic and iterate arriving DataSets until the
-    publisher ends the stream (NDArrayConsumer.java analog)."""
+    publisher ends the stream (NDArrayConsumer.java analog).
+
+    ``idle_timeout_s`` bounds the wait for the NEXT frame: a dead broker
+    otherwise hangs ``__iter__`` forever on a ``settimeout(None)`` socket.
+    On idle timeout the iterator raises ``StreamStalled`` — a typed,
+    diagnosable failure — rather than hanging or (worse) surfacing a
+    silent early end-of-stream to ``fit()``. Default ``None`` keeps the
+    block-indefinitely contract for live feeds with long producer idles."""
 
     def __init__(self, host: str, port: int, topic: str,
-                 connect_timeout: Optional[float] = 30.0):
+                 connect_timeout: Optional[float] = 30.0,
+                 idle_timeout_s: Optional[float] = None):
         self.topic = topic
+        self.idle_timeout_s = idle_timeout_s
         self._sock = socket.create_connection((host, port),
                                               timeout=connect_timeout)
         try:
@@ -90,14 +107,21 @@ class NDArrayConsumer:
         except BaseException:
             self._sock.close()  # no object escapes: close or leak the fd
             raise
-        # from here on, block indefinitely: a producer idling minutes
-        # between publishes is normal for a live training feed; a recv
-        # timeout would surface as a silent early end-of-stream to fit()
-        self._sock.settimeout(None)
+        # from here on, the wait-per-frame policy is the caller's choice:
+        # None blocks indefinitely (a producer idling minutes between
+        # publishes is normal for a live training feed), a bound turns a
+        # dead broker into a typed StreamStalled instead of a silent hang
+        self._sock.settimeout(idle_timeout_s)
 
     def __iter__(self) -> Iterator[DataSet]:
         while True:
-            frame = read_frame(self._sock)
+            try:
+                frame = read_frame(self._sock)
+            except socket.timeout:
+                raise StreamStalled(
+                    f"no frame on topic '{self.topic}' within the "
+                    f"{self.idle_timeout_s}s idle timeout — broker or "
+                    "publisher presumed dead") from None
             if frame is None:
                 return  # broker gone: treat as stream end
             op, _, payload = frame

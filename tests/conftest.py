@@ -131,6 +131,11 @@ def pytest_configure(config):
         "runs in tier-1, deliberately NOT in the slow set)")
     config.addinivalue_line(
         "markers",
+        "serving: serving-path resilience tests (deadlines, admission "
+        "control, breaker, chaos — CPU-fast; runs in tier-1, deliberately "
+        "NOT in the slow set)")
+    config.addinivalue_line(
+        "markers",
         "allow_step_recompiles: opt out of the per-test train-step "
         "recompile-count guard")
     config.addinivalue_line(

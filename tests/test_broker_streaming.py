@@ -8,6 +8,8 @@ process trains ``net.fit`` on the subscribed route."""
 
 import subprocess
 import sys
+import threading
+import time
 
 import numpy as np
 import pytest
@@ -18,6 +20,7 @@ from deeplearning4j_tpu.streaming import (
     NDArrayPublisher,
     NDArrayRoute,
     StreamingBroker,
+    StreamStalled,
     dataset_from_bytes,
     dataset_to_bytes,
 )
@@ -158,6 +161,103 @@ class TestCrossProcess:
             assert f"published {n_batches}" in out
             assert net.iteration == n_batches
             assert np.isfinite(net.score_value)
+        finally:
+            broker.stop()
+
+
+@pytest.mark.serving
+class TestSlowSubscriber:
+    """A slow consumer no longer stalls the topic forever: past the
+    publish-patience window its frames are dropped (counted in
+    ``broker.stats()``) and after ``drop_limit`` consecutive drops it is
+    evicted — while a healthy subscriber keeps seeing every frame."""
+
+    def test_drops_are_counted_and_persistent_laggard_evicted(self):
+        n_frames = 20
+        broker = StreamingBroker(port=0, subscriber_buffer=2, drop_limit=3,
+                                 publish_patience_s=0.05).start()
+        try:
+            # a subscriber that handshakes, then never reads another byte;
+            # big frames fill its socket buffer fast, then its queue
+            slow = NDArrayConsumer("127.0.0.1", broker.port, "lag")
+            fast_out = []
+            fast = NDArrayConsumer("127.0.0.1", broker.port, "lag")
+            t = threading.Thread(target=lambda: fast_out.extend(fast))
+            t.start()
+            big = np.zeros((64, 1024), np.float32)  # ~256 KB per frame
+            labels = np.ones((64, 1), np.float32)
+            with NDArrayPublisher("127.0.0.1", broker.port, "lag") as pub:
+                for _ in range(n_frames):
+                    pub.publish_arrays(big, labels)
+                pub.end()
+            t.join(30)
+            st = broker.stats()
+            assert st["frames_dropped"] > 0
+            assert st["dropped_by_topic"].get("lag", 0) \
+                == st["frames_dropped"]
+            assert st["subscribers_disconnected"] == 1
+            # the healthy subscriber missed nothing
+            assert len(fast_out) == n_frames
+            slow.close()
+        finally:
+            broker.stop()
+
+    def test_fast_subscribers_never_drop(self):
+        broker = StreamingBroker(port=0, subscriber_buffer=2, drop_limit=3,
+                                 publish_patience_s=0.05).start()
+        try:
+            out = []
+            cons = NDArrayConsumer("127.0.0.1", broker.port, "ok")
+            t = threading.Thread(target=lambda: out.extend(cons))
+            t.start()
+            with NDArrayPublisher("127.0.0.1", broker.port, "ok") as pub:
+                for i in range(10):
+                    pub.publish_arrays(np.full((1, 2), i, np.float32),
+                                       np.ones((1, 1), np.float32))
+                pub.end()
+            t.join(10)
+            assert len(out) == 10
+            st = broker.stats()
+            assert st["frames_dropped"] == 0
+            assert st["subscribers_disconnected"] == 0
+        finally:
+            broker.stop()
+
+
+@pytest.mark.serving
+class TestIdleTimeout:
+    def test_silent_topic_raises_stream_stalled(self):
+        """A consumer with an idle budget fails typed instead of hanging
+        forever on a topic nobody publishes to."""
+        broker = StreamingBroker(port=0).start()
+        try:
+            with NDArrayConsumer("127.0.0.1", broker.port, "dead",
+                                 idle_timeout_s=0.3) as cons:
+                start = time.monotonic()
+                with pytest.raises(StreamStalled, match="dead"):
+                    list(cons)
+                assert time.monotonic() - start < 5.0
+        finally:
+            broker.stop()
+
+    def test_timely_frames_do_not_stall(self):
+        """The timeout is per-frame idle time, not total stream time: a
+        stream longer than the budget flows as long as gaps stay under."""
+        broker = StreamingBroker(port=0).start()
+        try:
+            cons = NDArrayConsumer("127.0.0.1", broker.port, "live",
+                                   idle_timeout_s=2.0)
+            out = []
+            t = threading.Thread(target=lambda: out.extend(cons))
+            t.start()
+            with NDArrayPublisher("127.0.0.1", broker.port, "live") as pub:
+                for i in range(5):
+                    pub.publish_arrays(np.full((1, 2), i, np.float32),
+                                       np.ones((1, 1), np.float32))
+                    time.sleep(0.05)
+                pub.end()
+            t.join(10)
+            assert len(out) == 5
         finally:
             broker.stop()
 
