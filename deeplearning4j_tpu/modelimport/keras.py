@@ -264,10 +264,13 @@ def _layer_refs(v):
 
 
 def _is_linear(layers) -> bool:
-    """True when every non-input layer has exactly one distinct input and
-    nothing branches (each producer feeds at most one consumer). A model
-    with several InputLayers is never linear — flattening disjoint input
-    chains into one stack would mis-wire them."""
+    """True when every non-input layer has exactly one inbound CONNECTION
+    and nothing branches (each producer feeds at most one consumer). The
+    inbound names are counted WITHOUT dedup: ``Add()([x, x])`` names the
+    same tensor twice, but it is still a two-input merge — deduping would
+    flatten it into a linear stack and silently import x + x as x. A
+    model with several InputLayers is never linear — flattening disjoint
+    input chains into one stack would mis-wire them."""
     if sum(1 for l in layers if l["class_name"] == "InputLayer") > 1:
         return False
     consumers: dict = {}
@@ -275,7 +278,7 @@ def _is_linear(layers) -> bool:
         if l["class_name"] == "InputLayer":
             continue
         try:
-            ins = set(_inbound_names(l))
+            ins = _inbound_names(l)
         except ValueError:
             return False
         if len(ins) > 1:

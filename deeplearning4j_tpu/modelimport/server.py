@@ -14,8 +14,18 @@ an HTTP entry point serves any client), batches are ``.npz`` files with
 - POST /evaluate {"model": id, "batches": [paths]}      -> {"accuracy": ..}
 - POST /predict  {"model": id, "features": [[..], ..],
                   "deadline_s": 2.0}                    -> {"output": ..}
+- POST /generate {"model": id, "prompt_ids": [..], "max_tokens": n,
+                  "temperature": t, "top_k": k, "seed": s,
+                  "deadline_s": 2.0}                    -> {"tokens": [..]}
 - GET  /models                                          -> {"models": [..]}
 - GET  /stats                                           -> serving counters
+
+/generate serves models registered with ``attach_generation`` through a
+slot-pooled continuous-batching ``GenerationServer``
+(parallel/generation.py) and maps its typed failures onto the same
+taxonomy: 429 past the admission watermark, 503 while the breaker is
+open, 504 when the per-request deadline expires (queued OR
+mid-generation — the decode slot is freed either way).
 
 The serving path degrades typed instead of failing open
 (parallel/resilience.py): /predict sheds load with 429 past the
@@ -78,6 +88,7 @@ class KerasBackendServer:
         is discarded unbuffered, never parsed)."""
         self._port = port
         self._models: dict = {}
+        self._generators: dict = {}
         self._next_id = 0
         self._lock = threading.Lock()
         self._httpd = None
@@ -209,6 +220,65 @@ class KerasBackendServer:
             out = out[0]
         return np.asarray(out).tolist()
 
+    def attach_generation(self, net, *, vocab: int, slots: int = 4,
+                          eos_id: Optional[int] = None,
+                          mid: Optional[str] = None, **gen_kw) -> str:
+        """Register a causal LM for /generate, served by a slot-pooled
+        ``GenerationServer`` (continuous batching — parallel/generation.py).
+        ``net`` may be a model instance or an already-imported model id;
+        returns the model id /generate requests should name. Extra kwargs
+        (max_pending, request_deadline_s, retry, breaker, chaos, ...) are
+        forwarded to the ``GenerationServer``."""
+        from deeplearning4j_tpu.parallel.generation import GenerationServer
+
+        with self._lock:
+            if isinstance(net, str):
+                mid = net
+                net = self._net(mid)
+            elif mid is None:
+                mid = f"m{self._next_id}"
+                self._next_id += 1
+            self._models[mid] = net
+            old = self._generators.pop(mid, None)
+        if old is not None:
+            old.close()
+        gen = GenerationServer(net, vocab, slots=slots, eos_id=eos_id,
+                               **gen_kw)
+        with self._lock:
+            self._generators[mid] = gen
+        return mid
+
+    def generate(self, mid: str, prompt_ids, max_tokens: int,
+                 temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+                 deadline_s: Optional[float] = None) -> list:
+        """Submit one generation request and wait for its tokens. The
+        GenerationServer enforces admission/deadline/breaker typing; the
+        handler maps those onto 429/503/504 like /predict."""
+        with self._lock:
+            gen = self._generators.get(mid)
+        if gen is None:
+            raise UnknownModelError(
+                f"unknown generation model '{mid}' — register it with "
+                "attach_generation()")
+        budget = deadline_s if deadline_s is not None \
+            else self.request_deadline_s
+        fut = gen.submit(np.asarray(prompt_ids, np.int64),
+                         int(max_tokens), temperature=float(temperature),
+                         top_k=int(top_k), seed=int(seed),
+                         deadline_s=budget)
+        try:
+            # the server resolves deadlined requests itself; the extra
+            # slack only guards a wedged loop thread from hanging HTTP
+            out = fut.result(timeout=None if budget is None
+                             else budget + 30.0)
+        except Exception:
+            with self._stats_lock:
+                self._failed += 1
+            raise
+        with self._stats_lock:
+            self._completed += 1
+        return np.asarray(out).tolist()
+
     def list_models(self) -> list:
         with self._lock:
             return sorted(self._models)
@@ -225,6 +295,10 @@ class KerasBackendServer:
                    pending=self.admission.pending,
                    breaker_state=self.breaker.state,
                    models=len(self._models))
+        with self._lock:
+            gens = dict(self._generators)
+        if gens:
+            out["generation"] = {mid: g.stats() for mid, g in gens.items()}
         return out
 
     # ----------------------------------------------------------- lifecycle
@@ -296,6 +370,14 @@ class KerasBackendServer:
                         self._json({"output": server.predict(
                             req["model"], req["features"],
                             req.get("deadline_s"))})
+                    elif self.path == "/generate":
+                        self._json({"tokens": server.generate(
+                            req["model"], req["prompt_ids"],
+                            int(req["max_tokens"]),
+                            float(req.get("temperature", 0.0)),
+                            int(req.get("top_k", 0)),
+                            int(req.get("seed", 0)),
+                            req.get("deadline_s"))})
                     else:
                         self._error(404, "not found", "NotFound")
                 except UnknownModelError as e:
@@ -321,6 +403,11 @@ class KerasBackendServer:
         return self.port
 
     def stop(self) -> None:
+        with self._lock:
+            gens = list(self._generators.values())
+            self._generators.clear()
+        for g in gens:
+            g.close()
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()

@@ -834,6 +834,58 @@ class TransformerLM(ZooModel):
         return "ComputationGraph"
 
 
+def lm_stream_forward(net):
+    """One streaming forward chunk through ``net`` as a pure function:
+    ``fwd(params, state, x, carry, mask=None) -> (out, new_carry)``.
+
+    Papering over the MultiLayerNetwork/ComputationGraph `_forward`
+    signature split in ONE place so every decode program family —
+    `_device_generate`'s fused scan, GenerationServer's prefill-into-slot
+    and pooled decode step — traces the same forward."""
+    is_graph = hasattr(net.conf, "network_inputs")
+
+    def fwd(params, state, x, carry, mask=None):
+        if is_graph:
+            outs, _, new_carry, _, _ = net._forward(
+                params, state, [x], [mask], train=False, rng=None,
+                carry=carry)
+            return outs[0], new_carry
+        out, _, new_carry, _ = net._forward(params, state, x, mask,
+                                            train=False, rng=None,
+                                            carry=carry)
+        return out, new_carry
+
+    return fwd
+
+
+def sampled_next_token(probs, keys, temperature, top_k):
+    """Next-token select with TRACED per-row sampling params.
+
+    probs: [B, V] softmax outputs; keys: [B, 2] uint32 PRNG keys;
+    temperature/top_k: [B] float/int arrays — traced VALUES, not static
+    args, so a batch mixing greedy and sampled requests (any temp/top_k
+    combination) shares one compiled program. Rows with temperature <= 0
+    take the argmax — the same op `_device_generate` compiles for its
+    greedy path, so greedy results are bit-identical between the two.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    V = probs.shape[-1]
+    greedy = jnp.argmax(probs, axis=-1)
+    logits = jnp.log(jnp.maximum(probs, 1e-30)) \
+        / jnp.maximum(temperature, 1e-30)[:, None]
+    # per-row k-th-largest threshold via one full sort; top_k <= 0 rows
+    # disable the cut (threshold at the row minimum)
+    srt = jnp.sort(logits, axis=-1)                      # ascending
+    k_idx = jnp.clip(V - top_k, 0, V - 1)
+    kth = jnp.take_along_axis(srt, k_idx[:, None], axis=-1)
+    cut = (top_k[:, None] > 0) & (logits < kth)
+    logits = jnp.where(cut, -1e30, logits)
+    sampled = jax.vmap(jax.random.categorical)(keys, logits)
+    return jnp.where(temperature <= 0, greedy, sampled)
+
+
 def greedy_generate(net, prompt_ids, steps: int, vocab: int,
                     device_loop: bool = True):
     """Greedy decoding — ``sample_generate`` with temperature 0 (see
@@ -913,7 +965,6 @@ def _device_generate(net, prompt_ids, steps: int, vocab: int,
     import jax
     import jax.numpy as jnp
 
-    is_graph = hasattr(net.conf, "network_inputs")
     B = prompt_ids.shape[0]
     # generation is its own stream: any live rnn_time_step stream is
     # CLEARED (seeding below resets the overflow accounting, so leaving
@@ -937,16 +988,7 @@ def _device_generate(net, prompt_ids, steps: int, vocab: int,
     key = ("generate", B, prompt_ids.shape[1], steps, vocab,
            float(temperature), int(top_k) if temperature > 0 else 0)
     if key not in net._output_cache:
-        def fwd(params, state, x, carry):
-            if is_graph:
-                outs, _, new_carry, _, _ = net._forward(
-                    params, state, [x], [None], train=False, rng=None,
-                    carry=carry)
-                return outs[0], new_carry
-            out, _, new_carry, _ = net._forward(params, state, x, None,
-                                                train=False, rng=None,
-                                                carry=carry)
-            return out, new_carry
+        fwd = lm_stream_forward(net)
 
         def pick(probs, k):  # [B, V], key -> [B]
             if temperature <= 0:

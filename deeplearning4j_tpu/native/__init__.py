@@ -7,15 +7,18 @@ holds the framework's OWN native pieces — currently the data-loader hot
 path (numeric CSV parsing, deeplearning4j_tpu/native/fastio.c).
 
 Build contract: the shared object is compiled ON FIRST USE with the
-toolchain baked into the image (cc -O2 -shared -fPIC), cached next to the
-source, and every consumer falls back to the pure-Python path when the
-toolchain or the build is unavailable — native is an accelerator, never a
-hard dependency.
+toolchain baked into the image (cc -O2 -shared -fPIC) into a gitignored
+cache directory KEYED BY SOURCE HASH — no prebuilt binary is ever
+committed or loaded, so the bytes that run provably come from the .c file
+under review (a hash mismatch simply builds a new artifact). Every
+consumer falls back to the pure-Python path when the toolchain or the
+build is unavailable — native is an accelerator, never a hard dependency.
 """
 
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import shutil
 import subprocess
@@ -24,12 +27,44 @@ import threading
 import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SO = os.path.join(_DIR, "_fastio.so")
+_CACHE_DIR = os.path.join(_DIR, ".cache")
 _SRC = os.path.join(_DIR, "fastio.c")
 
 _lock = threading.Lock()
 _lib = None
 _tried = False
+
+
+def _build(src: str, stem: str, flags, libs=()) -> "str | None":
+    """Compile ``src`` into the gitignored cache dir, the artifact named
+    by the source's content hash: a reviewed-source edit can never load a
+    stale binary, and the cache survives across processes. Returns the
+    .so path, or None when the toolchain/build is unavailable."""
+    try:
+        with open(src, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    except OSError:
+        return None
+    so = os.path.join(_CACHE_DIR, f"{stem}-{digest}.so")
+    if os.path.exists(so):
+        return so
+    cc = (os.environ.get("CC") or shutil.which("cc")
+          or shutil.which("gcc"))
+    if cc is None:
+        return None
+    tmp = f"{so}.tmp{os.getpid()}"
+    try:
+        os.makedirs(_CACHE_DIR, exist_ok=True)
+        subprocess.run([cc, *flags, "-o", tmp, src, *libs], check=True,
+                       capture_output=True, timeout=120)
+        os.replace(tmp, so)  # atomic: concurrent builders race benignly
+    except (subprocess.SubprocessError, OSError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+    return so
 
 
 def _load():
@@ -38,25 +73,11 @@ def _load():
         if _tried:
             return _lib
         _tried = True
+        so = _build(_SRC, "_fastio", ["-O2", "-shared", "-fPIC"])
+        if so is None:
+            return None
         try:
-            stale = (not os.path.exists(_SO)
-                     or os.path.getmtime(_SO) < os.path.getmtime(_SRC))
-        except OSError:
-            # source missing but a built artifact exists: use it as-is
-            stale = not os.path.exists(_SO)
-        if stale:
-            cc = (os.environ.get("CC") or shutil.which("cc")
-                  or shutil.which("gcc"))
-            if cc is None:
-                return None
-            try:
-                subprocess.run([cc, "-O2", "-shared", "-fPIC", "-o", _SO,
-                                _SRC], check=True, capture_output=True,
-                               timeout=120)
-            except (subprocess.SubprocessError, OSError):
-                return None
-        try:
-            lib = ctypes.CDLL(_SO)
+            lib = ctypes.CDLL(so)
         except OSError:
             return None
         lib.parse_numeric_csv.restype = ctypes.c_long
@@ -73,7 +94,6 @@ def native_available() -> bool:
 
 
 # ---------------------------------------------------------------- skipgram
-_SG_SO = os.path.join(_DIR, "_skipgram.so")
 _SG_SRC = os.path.join(_DIR, "skipgram.c")
 _sg_lib = None
 _sg_tried = False
@@ -85,26 +105,15 @@ def _load_skipgram():
         if _sg_tried:
             return _sg_lib
         _sg_tried = True
+        # -O3 -ffast-math: the dot/axpy inner loops vectorize; the
+        # reference's libnd4j kernel is likewise SIMD C++
+        so = _build(_SG_SRC, "_skipgram",
+                    ["-O3", "-ffast-math", "-shared", "-fPIC"],
+                    libs=["-lm"])
+        if so is None:
+            return None
         try:
-            stale = (not os.path.exists(_SG_SO)
-                     or os.path.getmtime(_SG_SO) < os.path.getmtime(_SG_SRC))
-        except OSError:
-            stale = not os.path.exists(_SG_SO)
-        if stale:
-            cc = (os.environ.get("CC") or shutil.which("cc")
-                  or shutil.which("gcc"))
-            if cc is None:
-                return None
-            try:
-                # -O3 -ffast-math: the dot/axpy inner loops vectorize;
-                # the reference's libnd4j kernel is likewise SIMD C++
-                subprocess.run([cc, "-O3", "-ffast-math", "-shared",
-                                "-fPIC", "-o", _SG_SO, _SG_SRC, "-lm"],
-                               check=True, capture_output=True, timeout=120)
-            except (subprocess.SubprocessError, OSError):
-                return None
-        try:
-            lib = ctypes.CDLL(_SG_SO)
+            lib = ctypes.CDLL(so)
         except OSError:
             return None
         lib.skipgram_train.restype = ctypes.c_long

@@ -225,7 +225,21 @@ class SequenceVectors:
             alpha=self.learning_rate, min_alpha=self.min_learning_rate,
             epochs=self.epochs * self.iterations, seed=self.seed or 1)
         if out is None:  # toolchain raced away: device fallback
-            return self._fit_element_epochs(sentences)
+            # ``sentences`` may be a one-shot generator the corpus walk
+            # above already consumed — re-iterating it would train on
+            # NOTHING. Rebuild token sentences from the materialized
+            # index corpus instead (vocab words only, which is exactly
+            # the token stream the device path trains on anyway).
+            rebuilt, cur = [], []
+            for i in corpus:
+                if i < 0:
+                    rebuilt.append(cur)
+                    cur = []
+                else:
+                    cur.append(cache.word_at_index(i))
+            if cur:
+                rebuilt.append(cur)
+            return self._fit_element_epochs(rebuilt)
         _, self.syn0, self.syn1neg = out
         return self
 

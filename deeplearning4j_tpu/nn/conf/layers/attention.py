@@ -115,7 +115,7 @@ class SelfAttentionLayer(BaseLayer):
 
     def forward(self, params, state, x, *, mask=None, train=False, rng=None):
         if "kcache" in state:
-            return self._streaming_forward(params, state, x)
+            return self._streaming_forward(params, state, x, mask=mask)
         x = self.apply_input_dropout(x, train=train, rng=rng)
         q = self._split_heads(jnp.einsum("btf,fo->bto", x, params["Wq"]))
         k = self._split_heads(jnp.einsum("btf,fo->bto", x, params["Wk"]))
@@ -147,33 +147,85 @@ class SelfAttentionLayer(BaseLayer):
             "cache_pos": jnp.zeros((), jnp.int32),
         }
 
-    def _streaming_forward(self, params, state, x):
+    def _streaming_forward(self, params, state, x, mask=None):
+        """Incremental decode over the KV cache.
+
+        ``cache_pos`` may be a scalar (one shared stream position — the
+        classic rnn_time_step path) or a ``[B]`` vector of PER-ROW
+        positions (slot-pooled serving: each batch row is an independent
+        sequence at its own depth, so attention is masked per-row by that
+        row's true length and the new chunk is scattered at per-row
+        offsets).
+
+        ``mask``: optional ``[B, T]`` validity of the NEW chunk's
+        positions. Masked positions contribute no attention keys and
+        their outputs are zeroed (matching the non-streaming path), but
+        they still occupy cache columns — ``cache_pos`` advances by the
+        full chunk length; callers that right-pad (bucketed prefill) must
+        set their own true-length watermark afterwards. Any other mask
+        shape is an error: silently dropping it would let padded garbage
+        attend as real keys.
+        """
         B, T, _ = x.shape
         kc, vc, pos = state["kcache"], state["vcache"], state["cache_pos"]
         Tmax = kc.shape[2]
-        if not isinstance(pos, jax.core.Tracer) and int(pos) + T > Tmax:
-            raise ValueError(
-                f"KV cache overflow: position {int(pos)} + {T} new tokens "
-                f"> max_cache {Tmax}; raise SelfAttentionLayer.max_cache "
-                "or rnn_clear_previous_state() to start a new stream")
+        per_row = getattr(pos, "ndim", 0) == 1
+        if not isinstance(pos, jax.core.Tracer):
+            hi = int(jnp.max(pos)) if per_row else int(pos)
+            if hi + T > Tmax:
+                raise ValueError(
+                    f"KV cache overflow: position {hi} + {T} new tokens "
+                    f"> max_cache {Tmax}; raise SelfAttentionLayer.max_cache "
+                    "or rnn_clear_previous_state() to start a new stream")
+        if mask is not None:
+            mask = jnp.asarray(mask)
+            if mask.shape != (B, T):
+                raise ValueError(
+                    f"streaming attention mask must be [batch, chunk] = "
+                    f"({B}, {T}), got {mask.shape}; per-feature or "
+                    "flattened masks cannot be applied to the KV cache")
         q = self._split_heads(jnp.einsum("btf,fo->bto", x, params["Wq"]))
         k = self._split_heads(jnp.einsum("btf,fo->bto", x, params["Wk"]))
         v = self._split_heads(jnp.einsum("btf,fo->bto", x, params["Wv"]))
-        z = jnp.zeros((), jnp.int32)  # index dtypes must all match pos's
-        kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
-                                          (z, z, pos, z))
-        vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
-                                          (z, z, pos, z))
+        if per_row:
+            # scatter each row's chunk at its own offset: advanced indices
+            # [B,1] x [B,T] straddle the head slice, so the updated value
+            # carries [B,T,H,d] layout
+            bidx = jnp.arange(B)[:, None]
+            t_idx = pos[:, None] + jnp.arange(T)[None, :]
+            kc = kc.at[bidx, :, t_idx, :].set(
+                k.astype(kc.dtype).transpose(0, 2, 1, 3))
+            vc = vc.at[bidx, :, t_idx, :].set(
+                v.astype(vc.dtype).transpose(0, 2, 1, 3))
+        else:
+            z = jnp.zeros((), jnp.int32)  # index dtypes must all match pos's
+            kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
+                                              (z, z, pos, z))
+            vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
+                                              (z, z, pos, z))
         d = q.shape[-1]
         logits = jnp.einsum("bhtd,bhkd->bhtk", q, kc) / jnp.sqrt(
             jnp.asarray(d, q.dtype))
         col = jnp.arange(Tmax)[None, None, None, :]
         row = jnp.arange(T)[None, None, :, None]
-        logits = jnp.where(col <= pos + row, logits, NEG_INF)
+        p4 = pos.reshape(-1, 1, 1, 1) if per_row else pos
+        logits = jnp.where(col <= p4 + row, logits, NEG_INF)
+        if mask is not None:
+            # key validity over the cache axis: columns belonging to this
+            # chunk take the chunk mask; everything older stays valid
+            colv = jnp.arange(Tmax)[None, :]
+            rel = colv - (pos[:, None] if per_row else pos)     # [B?,Tmax]
+            rel = jnp.broadcast_to(rel, (B, Tmax))
+            chunk_valid = jnp.take_along_axis(
+                mask.astype(bool), jnp.clip(rel, 0, T - 1), axis=1)
+            key_valid = jnp.where((rel >= 0) & (rel < T), chunk_valid, True)
+            logits = jnp.where(key_valid[:, None, None, :], logits, NEG_INF)
         o = jnp.einsum("bhtk,bhkd->bhtd",
                        jax.nn.softmax(logits, axis=-1), vc)
         o = o.transpose(0, 2, 1, 3).reshape(B, T, self.n_out)
         out = jnp.einsum("bto,op->btp", o, params["Wo"]) + params["b"]
+        if mask is not None:
+            out = out * mask.astype(out.dtype)[:, :, None]
         new_state = dict(state)
         new_state["kcache"] = kc
         new_state["vcache"] = vc
@@ -208,13 +260,18 @@ class PositionalEncodingLayer(Layer):
     def forward(self, params, state, x, *, mask=None, train=False, rng=None):
         T, F = x.shape[-2], x.shape[-1]
         start = state.get("cache_pos")
-        pos = jnp.arange(T, dtype=jnp.float32)[:, None] \
-            + (0.0 if start is None else start.astype(jnp.float32))
+        if start is not None and getattr(start, "ndim", 0) == 1:
+            # per-row stream positions (slot-pooled decode): [B, T, 1]
+            pos = start.astype(jnp.float32)[:, None, None] \
+                + jnp.arange(T, dtype=jnp.float32)[None, :, None]
+        else:
+            pos = jnp.arange(T, dtype=jnp.float32)[:, None] \
+                + (0.0 if start is None else start.astype(jnp.float32))
         half = (F + 1) // 2
         freq = jnp.exp(-jnp.log(self.max_wavelength)
                        * jnp.arange(half, dtype=jnp.float32) / max(half, 1))
-        ang = pos * freq[None, :]                       # [T, half]
-        pe = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)[:, :F]
+        ang = pos * freq                          # [..., T, half]
+        pe = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)[..., :F]
         out = x + pe.astype(x.dtype)
         if start is None:
             return out, state

@@ -50,6 +50,24 @@ def bucket_rows(n: int, multiple: int = 1) -> int:
     return b
 
 
+def bucket_length(n: int, minimum: int = 8,
+                  maximum: "int | None" = None) -> int:
+    """Canonical padded TIME length for an ``n``-token sequence: the
+    smallest power of two >= max(n, minimum), capped at ``maximum``.
+    Prompt prefill keys its jit cache on this, so arbitrary prompt
+    lengths collapse onto a handful of stable program shapes. ``minimum``
+    stops one-token prompts from minting their own tiny buckets;
+    ``maximum`` (the KV-cache capacity) is a hard bound — beyond it the
+    sequence cannot fit at all."""
+    if maximum is not None and n > maximum:
+        raise ValueError(f"sequence of {n} tokens exceeds the maximum "
+                         f"bucketed length {maximum}")
+    b = bucket_rows(max(int(n), int(minimum)))
+    if maximum is not None and b > maximum:
+        b = int(maximum)
+    return b
+
+
 def pad_rows(a, target: int):
     """Pad ``a``'s leading dim up to ``target`` by replicating the last row
     (numpy in, numpy out; jax in, jax out — device arrays are padded on

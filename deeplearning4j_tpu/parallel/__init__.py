@@ -18,6 +18,7 @@ from deeplearning4j_tpu.parallel.model_sharding import (
     shard_network,
 )
 from deeplearning4j_tpu.parallel.inference import ParallelInference
+from deeplearning4j_tpu.parallel.generation import GenerationServer
 from deeplearning4j_tpu.parallel.resilience import (
     AdmissionController,
     ChaosPolicy,

@@ -1,0 +1,579 @@
+"""Continuous-batching generation serving: slot-pooled KV caches.
+
+``sample_generate`` compiles a whole decode into one program per request —
+great latency for ONE caller, but N concurrent callers run N programs
+back-to-back: a long request head-of-line-blocks everything behind it and
+every step does batch-1 matmuls. ``GenerationServer`` applies
+iteration-level (continuous) batching — Orca (Yu et al., OSDI '22) — over
+a fixed pool of S decode slots backed by ONE pre-allocated KV-cache pytree
+of shape ``[S, ...]`` (the dense-slot special case of vLLM's paged pool,
+Kwon et al., SOSP '23):
+
+- ONE compiled decode step advances ALL active sequences per iteration.
+  Per-slot stream positions ride in the carry as a ``[S]`` vector (the
+  attention layer masks each row by its own true length), so empty or
+  finished slots compute masked-out garbage and occupancy changes NEVER
+  retrace — the step compiles exactly once.
+- New requests are admitted into free slots between steps by a compiled
+  prefill-into-slot program; prompt lengths are padded onto pow2 buckets
+  (``optimize/bucketing.bucket_length``) so prefill has a handful of
+  stable shapes. The prompt's padded tail is masked out of attention and
+  the slot's length watermark is set to the TRUE prompt length.
+- Finished sequences (EOS or max-tokens) retire their slot immediately
+  and resolve their ``Future`` — short requests are never held hostage
+  by long ones.
+- Sampling params (temperature / top_k / rng) are traced per-slot VALUES,
+  not static args, so a batch mixing greedy and sampled requests shares
+  the same program. Greedy rows take the same argmax op
+  ``_device_generate`` compiles, so greedy outputs are bit-identical to
+  ``greedy_generate``.
+
+The serving posture mirrors ``ParallelInference`` (parallel/resilience.py):
+``submit(...) -> Future``, an ``AdmissionController`` watermark on the
+waiting queue (``ServerOverloaded`` past it), per-request deadlines checked
+between steps (``DeadlineExceeded`` — queued or mid-generation, the slot is
+freed either way), a circuit breaker over dispatch health, retries for
+transient faults, and a ``drain()``/``close()`` lifecycle that resolves
+every outstanding future.
+
+The pooled carry is donated back to each step on every backend (CPU
+included — XLA aliases host buffers too), so the cache updates in place:
+a decode step writes one column per slot instead of copying S full
+caches per iteration.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.optimize.bucketing import bucket_length
+from deeplearning4j_tpu.parallel.resilience import (AdmissionController,
+                                                    ChaosPolicy,
+                                                    CircuitBreaker,
+                                                    CircuitOpen, Deadline,
+                                                    DeadlineExceeded,
+                                                    RetryPolicy)
+
+_UNSET = object()
+
+
+class _Request:
+    __slots__ = ("prompt", "max_tokens", "temperature", "top_k", "seed",
+                 "eos_id", "deadline", "future", "tokens", "t_submit")
+
+    def __init__(self, prompt, max_tokens, temperature, top_k, seed,
+                 eos_id, deadline):
+        self.prompt = prompt
+        self.max_tokens = max_tokens
+        self.temperature = temperature
+        self.top_k = top_k
+        self.seed = seed
+        self.eos_id = eos_id
+        self.deadline = deadline
+        self.future = Future()
+        self.tokens: list = []
+        self.t_submit = time.monotonic()
+
+
+class GenerationServer:
+    """Slot-pooled continuous-batching decode server for a causal LM.
+
+    ``net`` must stream through an explicit KV-cache carry (TransformerLM:
+    attention kcache/vcache + positional counters). ``submit`` returns a
+    ``concurrent.futures.Future`` resolving to the generated token ids
+    (numpy int array, EOS token included when hit).
+    """
+
+    def __init__(self, net, vocab: int, *, slots: int = 8,
+                 eos_id: Optional[int] = None,
+                 max_pending: int = 64,
+                 request_deadline_s: Optional[float] = None,
+                 min_prefill_bucket: int = 8,
+                 retry: Optional[RetryPolicy] = None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 chaos: Optional[ChaosPolicy] = None):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        self.net = net
+        self.vocab = int(vocab)
+        self.slots = int(slots)
+        self.eos_id = eos_id
+        self.request_deadline_s = request_deadline_s
+        self.min_prefill_bucket = int(min_prefill_bucket)
+        self.admission = AdmissionController(max_pending)
+        self.retry = retry if retry is not None else RetryPolicy()
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
+        self._chaos = chaos
+
+        self._cond = threading.Condition()
+        self._queue: deque = deque()
+        self._slot_req: list = [None] * self.slots
+        self._n_active = 0
+        self._closing = False
+        self._stop = False
+
+        # host mirrors of the per-slot decode state fed to the step
+        self._last = np.zeros(self.slots, np.int32)
+        self._counts = np.zeros(self.slots, np.int32)
+        self._temp = np.zeros(self.slots, np.float32)
+        self._topk = np.zeros(self.slots, np.int32)
+        self._keys = np.zeros((self.slots, 2), np.uint32)
+
+        self._admitted = 0
+        self._expired = 0
+        self._retired = 0
+        self._completed = 0
+        self._failed = 0
+        self._retried = 0
+        self._prefills = 0
+        self._decode_steps = 0
+        self._tokens = 0
+        self._busy_s = 0.0
+
+        self._capacity = None
+        self._carry = self._fresh_pool()
+        if self._carry is None:
+            raise ValueError(
+                "net has no seedable streaming KV carry — GenerationServer "
+                "serves KV-cache streaming language models (TransformerLM)")
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="generation-server")
+        self._thread.start()
+
+    # ----------------------------------------------------------- programs
+    def _fresh_pool(self):
+        """ONE pre-allocated pooled carry of leading dim ``slots``; the
+        per-vertex scalar stream counters become [S] vectors so every
+        slot decodes at its own depth inside one program."""
+        import jax
+        import jax.numpy as jnp
+
+        net = self.net
+        net.rnn_clear_previous_state()
+        seed = net._seed_streaming_carry(self.slots)
+        self._capacity = net._stream_capacity
+        net.rnn_clear_previous_state()
+        if not seed:
+            return None
+        pool = {}
+        for vname, vdict in seed.items():
+            pool[vname] = {
+                k: (jnp.zeros((self.slots,), jnp.int32) if k == "cache_pos"
+                    else v)
+                for k, v in vdict.items()}
+        return jax.device_put(pool)
+
+    def _donate(self):
+        # the pooled carry (arg 2 of both programs) is donated back every
+        # dispatch so the KV pool updates IN PLACE — without it each step
+        # copies every cache leaf just to rewrite one column. XLA treats
+        # an un-donatable buffer as copy + warning, never an error, and
+        # CPU/TPU both alias here (verified: same buffer pointer back)
+        return (2,)
+
+    def _decode_program(self):
+        """The single decode step: one-hot feedback of each slot's last
+        token, one streaming forward over the pool, traced per-slot
+        sampling. Compiled ONCE — occupancy, positions, and sampling
+        params are all data, not shape."""
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.models.zoo import (lm_stream_forward,
+                                                   sampled_next_token)
+
+        net, vocab = self.net, self.vocab
+        key = ("gen_decode", self.slots, vocab)
+
+        def build():
+            fwd = lm_stream_forward(net)
+            dtype = jnp.dtype(net.conf.dtype)
+
+            def step(params, state, carry, last, active, temp, topk,
+                     base_keys, counts):
+                x = jax.nn.one_hot(last, vocab, dtype=dtype)[:, None, :]
+                out, new_carry = fwd(params, state, x, carry)
+                # freeze empty slots' stream counters: their garbage
+                # writes then land on one fixed column forever instead of
+                # drifting toward the cache edge
+                for vname, vdict in new_carry.items():
+                    if "cache_pos" in vdict:
+                        old = carry[vname]["cache_pos"]
+                        vdict["cache_pos"] = jnp.where(
+                            active, vdict["cache_pos"], old)
+                keys = jax.vmap(jax.random.fold_in)(base_keys, counts)
+                nxt = sampled_next_token(out[:, 0], keys, temp, topk)
+                return new_carry, nxt
+
+            return jax.jit(step, donate_argnums=self._donate())
+
+        return net._get_output(key, build)
+
+    def _prefill_program(self, bucket: int):
+        """Prefill-into-slot for one prompt bucket: consume the (right-
+        padded, masked) prompt with a fresh batch-1 carry, sample the
+        first token from the last TRUE position, scatter the filled
+        caches into pool row ``slot`` and set its length watermark to the
+        true prompt length. One program per pow2 bucket."""
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.models.zoo import (lm_stream_forward,
+                                                   sampled_next_token)
+
+        net, vocab = self.net, self.vocab
+        key = ("gen_prefill", self.slots, vocab, bucket)
+
+        def build():
+            fwd = lm_stream_forward(net)
+
+            def prefill(params, state, pool, slot, prompt_onehot, mask,
+                        plen, temp, topk, base_key):
+                one = {}
+                for vname, vdict in pool.items():
+                    one[vname] = {
+                        k: (jnp.zeros((), jnp.int32) if k == "cache_pos"
+                            else jnp.zeros((1,) + v.shape[1:], v.dtype))
+                        for k, v in vdict.items()}
+                out, c1 = fwd(params, state, prompt_onehot, one, mask)
+                probs = out[0, plen - 1]
+                k0 = jax.random.fold_in(base_key, 0)
+                first = sampled_next_token(probs[None], k0[None],
+                                           temp[None], topk[None])[0]
+                new_pool = {}
+                for vname, vdict in pool.items():
+                    nv = {}
+                    for k, v in vdict.items():
+                        if k == "cache_pos":
+                            nv[k] = v.at[slot].set(plen)
+                        else:
+                            nv[k] = v.at[slot].set(c1[vname][k][0])
+                    new_pool[vname] = nv
+                return new_pool, first
+
+            return jax.jit(prefill, donate_argnums=self._donate())
+
+        return net._get_output(key, build)
+
+    # ------------------------------------------------------------- submit
+    def submit(self, prompt_ids, max_tokens: int, *,
+               temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+               eos_id=_UNSET, deadline_s: Optional[float] = None) -> Future:
+        """Queue one generation request; returns a Future resolving to
+        the generated ids ([<= max_tokens] numpy int array — shorter when
+        the per-request ``eos_id`` / server default is produced, which is
+        included). Raises ``ServerOverloaded`` past the admission
+        watermark and ``CircuitOpen`` while dispatches are failing."""
+        prompt = np.asarray(prompt_ids)
+        if prompt.ndim != 1 or prompt.shape[0] < 1:
+            raise ValueError(f"prompt_ids must be a non-empty 1-D id "
+                             f"array, got shape {prompt.shape}")
+        if max_tokens < 1:
+            raise ValueError(f"max_tokens must be >= 1, got {max_tokens}")
+        if temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        if top_k < 0 or top_k > self.vocab:
+            raise ValueError(f"top_k must be in [0, {self.vocab}], "
+                             f"got {top_k}")
+        plen = int(prompt.shape[0])
+        bucket = bucket_length(plen, minimum=self.min_prefill_bucket,
+                               maximum=self._capacity)
+        if self._capacity is not None:
+            needed = max(bucket, plen + int(max_tokens) - 1)
+            if needed > self._capacity:
+                raise ValueError(
+                    f"prompt + generated positions ({needed}) exceed the "
+                    f"KV-cache capacity ({self._capacity}); raise "
+                    "SelfAttentionLayer.max_cache or lower max_tokens")
+        if self._closing:
+            raise RuntimeError("GenerationServer is closed")
+        if not self.breaker.allow():
+            raise CircuitOpen("circuit breaker is open: recent decode "
+                              "dispatches failed above threshold")
+        budget = deadline_s if deadline_s is not None \
+            else self.request_deadline_s
+        req = _Request(prompt.astype(np.int64), int(max_tokens),
+                       float(temperature), int(top_k), int(seed),
+                       self.eos_id if eos_id is _UNSET else eos_id,
+                       None if budget is None else Deadline(budget))
+        self.admission.acquire()  # raises ServerOverloaded at watermark
+        req.future.add_done_callback(lambda _f: self.admission.release())
+        with self._cond:
+            if self._closing:
+                # lost the race with close(): fail typed, not hung
+                self._fail(req, RuntimeError("GenerationServer is closed"))
+                return req.future
+            self._queue.append(req)
+            self._cond.notify_all()
+        return req.future
+
+    # ---------------------------------------------------------- the loop
+    def _loop(self):
+        while True:
+            with self._cond:
+                if self._stop:
+                    return
+                if not self._queue and self._n_active == 0:
+                    self._cond.wait(timeout=0.5)
+                    continue
+            try:
+                self._admit_free_slots()
+                if self._n_active:
+                    t0 = time.monotonic()
+                    self._decode_once()
+                    self._busy_s += time.monotonic() - t0
+                self._expire_active()
+            except Exception as e:  # noqa: BLE001 — a loop death would
+                # hang every outstanding future; fail them typed instead
+                self._fail_all(e)
+
+    def _pop_admittable(self):
+        """Next queued request still worth prefilling (expired ones fail
+        typed on the way)."""
+        with self._cond:
+            while self._queue:
+                req = self._queue.popleft()
+                if req.deadline is not None and req.deadline.expired():
+                    self._expired += 1
+                    self._fail(req, DeadlineExceeded(
+                        "request budget exhausted while queued "
+                        f"({-req.deadline.remaining() * 1e3:.1f} ms over)"))
+                    continue
+                return req
+        return None
+
+    def _admit_free_slots(self):
+        for s in range(self.slots):
+            if self._slot_req[s] is not None:
+                continue
+            req = self._pop_admittable()
+            if req is None:
+                return
+            try:
+                self._prefill_into(s, req)
+            except Exception as e:  # noqa: BLE001 — typed failure for
+                # this request only; the slot stays free for the next one
+                with self._cond:
+                    if isinstance(e, DeadlineExceeded):
+                        self._expired += 1
+                    else:
+                        self._failed += 1
+                self._fail(req, e)
+
+    def _prefill_into(self, slot: int, req: _Request):
+        import jax
+
+        plen = int(req.prompt.shape[0])
+        bucket = bucket_length(plen, minimum=self.min_prefill_bucket,
+                               maximum=self._capacity)
+        prog = self._prefill_program(bucket)
+        dtype = np.dtype(self.net.conf.dtype)
+        onehot = np.zeros((1, bucket, self.vocab), dtype)
+        onehot[0, np.arange(plen), req.prompt] = 1
+        mask = np.zeros((1, bucket), np.float32)
+        mask[0, :plen] = 1
+        base_key = np.asarray(jax.random.PRNGKey(req.seed), np.uint32)
+        dispatch = prog if self._chaos is None else self._chaos.wrap(prog)
+
+        def attempt():
+            try:
+                out = dispatch(self.net.params, self.net.state, self._carry,
+                               np.int32(slot), onehot, mask, np.int32(plen),
+                               np.float32(req.temperature),
+                               np.int32(req.top_k), base_key)
+            except Exception:
+                self.breaker.record_failure()
+                raise
+            self.breaker.record_success()
+            return out
+
+        t0 = time.monotonic()
+        new_pool, first = self.retry.call(attempt, deadline=req.deadline,
+                                          on_retry=self._count_retry)
+        self._carry = new_pool
+        self._busy_s += time.monotonic() - t0
+        self._prefills += 1
+        tok = int(first)
+        self._last[slot] = tok
+        self._counts[slot] = 1
+        self._temp[slot] = req.temperature
+        self._topk[slot] = req.top_k
+        self._keys[slot] = base_key
+        req.tokens.append(tok)
+        with self._cond:
+            self._slot_req[slot] = req
+            self._n_active += 1
+            self._admitted += 1
+            self._tokens += 1
+        if self._finished(req, tok):
+            self._retire(slot)
+
+    def _decode_once(self):
+        prog = self._decode_program()
+        active = np.array([r is not None for r in self._slot_req])
+        dispatch = prog if self._chaos is None else self._chaos.wrap(prog)
+
+        def attempt():
+            try:
+                out = dispatch(self.net.params, self.net.state, self._carry,
+                               self._last, active, self._temp, self._topk,
+                               self._keys, self._counts)
+            except Exception:
+                self.breaker.record_failure()
+                raise
+            self.breaker.record_success()
+            return out
+
+        try:
+            new_carry, nxt = self.retry.call(attempt,
+                                             on_retry=self._count_retry)
+        except Exception as e:  # noqa: BLE001 — carry state is now
+            # suspect (possibly donated away): fail the batch typed and
+            # restart from a fresh pool so later requests still serve
+            self._fail_all(e)
+            return
+        self._carry = new_carry
+        self._decode_steps += 1
+        toks = np.asarray(nxt)
+        for s in range(self.slots):
+            req = self._slot_req[s]
+            if req is None:
+                continue
+            tok = int(toks[s])
+            req.tokens.append(tok)
+            self._counts[s] += 1
+            self._last[s] = tok
+            with self._cond:
+                self._tokens += 1
+            if self._finished(req, tok):
+                self._retire(s)
+
+    def _finished(self, req: _Request, tok: int) -> bool:
+        if req.eos_id is not None and tok == req.eos_id:
+            return True
+        return len(req.tokens) >= req.max_tokens
+
+    def _retire(self, slot: int):
+        req = self._slot_req[slot]
+        with self._cond:
+            self._slot_req[slot] = None
+            self._n_active -= 1
+            self._retired += 1
+            self._completed += 1
+            self._cond.notify_all()
+        try:
+            req.future.set_result(np.asarray(req.tokens, np.int64))
+        except Exception:  # future cancelled/resolved by the caller
+            pass
+
+    def _expire_active(self):
+        for s in range(self.slots):
+            req = self._slot_req[s]
+            if req is None or req.deadline is None \
+                    or not req.deadline.expired():
+                continue
+            with self._cond:
+                self._slot_req[s] = None
+                self._n_active -= 1
+                self._expired += 1
+                self._cond.notify_all()
+            self._fail(req, DeadlineExceeded(
+                "request budget exhausted mid-generation after "
+                f"{len(req.tokens)} tokens"))
+
+    def _fail(self, req: _Request, exc: BaseException):
+        try:
+            req.future.set_exception(exc)
+        except Exception:  # already resolved/cancelled
+            pass
+
+    def _fail_all(self, exc: BaseException):
+        """Hard dispatch fault: every in-flight request fails typed (never
+        hangs) and the pooled carry is rebuilt from zeros."""
+        with self._cond:
+            victims = [r for r in self._slot_req if r is not None]
+            victims += list(self._queue)
+            self._queue.clear()
+            self._slot_req = [None] * self.slots
+            self._n_active = 0
+            self._failed += len(victims)
+            self._cond.notify_all()
+        for req in victims:
+            self._fail(req, exc)
+        self._carry = self._fresh_pool()
+
+    def _count_retry(self, attempt, exc):
+        with self._cond:
+            self._retried += 1
+
+    # --------------------------------------------------------- lifecycle
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until every queued and in-flight request has resolved
+        (completed, expired, or failed). Returns False on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while self._queue or self._n_active:
+                left = None if deadline is None \
+                    else deadline - time.monotonic()
+                if left is not None and left <= 0:
+                    return False
+                self._cond.wait(timeout=0.05 if left is None
+                                else min(left, 0.05))
+        return True
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Stop admitting, drain what is in flight, stop the loop. Any
+        request still unresolved past ``timeout`` fails typed — a closed
+        server never leaves a hung future behind."""
+        with self._cond:
+            if self._closing and self._stop:
+                return
+            self._closing = True
+            self._cond.notify_all()
+        self.drain(timeout)
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._thread.join(timeout=max(timeout, 1.0))
+        with self._cond:
+            victims = [r for r in self._slot_req if r is not None]
+            victims += list(self._queue)
+            self._queue.clear()
+            self._slot_req = [None] * self.slots
+            self._n_active = 0
+        for req in victims:
+            self._fail(req, RuntimeError("GenerationServer closed with "
+                                         "the request still in flight"))
+
+    # ------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        """Serving counters: the observable surface for /stats, the
+        bench, and ops."""
+        with self._cond:
+            out = {
+                "slots": self.slots,
+                "active_slots": self._n_active,
+                "queued": len(self._queue),
+                "admitted": self._admitted,
+                "expired": self._expired,
+                "retired": self._retired,
+                "completed": self._completed,
+                "failed": self._failed,
+                "retried": self._retried,
+                "prefills": self._prefills,
+                "decode_steps": self._decode_steps,
+                "tokens_generated": self._tokens,
+                "tokens_per_s": (self._tokens / self._busy_s
+                                 if self._busy_s > 0 else 0.0),
+            }
+        out.update(accepted=self.admission.accepted,
+                   rejected=self.admission.rejected,
+                   pending=self.admission.pending,
+                   breaker_state=self.breaker.state)
+        return out

@@ -122,6 +122,14 @@ class StreamingBroker:
         self._subs_disconnected = 0
         self._dropped_by_topic: dict = {}
 
+    def _track(self, t: threading.Thread) -> None:
+        """Retain ``t`` for lifecycle introspection, pruning finished
+        threads first: a long-lived broker serving N connect/disconnect
+        cycles keeps O(live) entries, not O(N) dead Thread objects."""
+        with self._lock:
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
+
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "StreamingBroker":
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -132,7 +140,7 @@ class StreamingBroker:
         t = threading.Thread(target=self._accept_loop, daemon=True,
                              name="broker-accept")
         t.start()
-        self._threads.append(t)
+        self._track(t)
         return self
 
     def stop(self) -> None:
@@ -160,7 +168,7 @@ class StreamingBroker:
             t = threading.Thread(target=self._serve, args=(conn,),
                                  daemon=True)
             t.start()
-            self._threads.append(t)
+            self._track(t)
 
     def _serve(self, conn: socket.socket):
         try:
@@ -200,7 +208,7 @@ class StreamingBroker:
             self._subs.setdefault(topic, []).append(sub)
         t = threading.Thread(target=self._writer, args=(sub,), daemon=True)
         t.start()
-        self._threads.append(t)
+        self._track(t)
 
     def _writer(self, sub: _Subscriber):
         try:

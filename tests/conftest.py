@@ -136,6 +136,12 @@ def pytest_configure(config):
         "NOT in the slow set)")
     config.addinivalue_line(
         "markers",
+        "generation: continuous-batching generation serving tests "
+        "(slot-pooled KV cache, prefill buckets, decode-step recompile "
+        "guard — CPU-fast; runs in tier-1, deliberately NOT in the slow "
+        "set)")
+    config.addinivalue_line(
+        "markers",
         "allow_step_recompiles: opt out of the per-test train-step "
         "recompile-count guard")
     config.addinivalue_line(

@@ -106,6 +106,26 @@ class TestBrokerInProcess:
         finally:
             broker.stop()
 
+    def test_thread_registry_bounded_over_reconnect_cycles(self):
+        """A long-lived broker serving many connect/disconnect cycles must
+        not accumulate one dead Thread object per connection: the registry
+        prunes finished threads, keeping O(live) entries after 50 cycles."""
+        broker = StreamingBroker(port=0).start()
+        try:
+            for i in range(50):
+                with NDArrayPublisher("127.0.0.1", broker.port,
+                                      "tb") as pub:
+                    pub.publish_arrays(np.full((1, 2), i, np.float32),
+                                       np.ones((1, 1), np.float32))
+            # pruning happens as threads are tracked, so the registry
+            # holds the accept thread plus at most the last few
+            # connections still winding down — never all 50
+            assert len(broker._threads) < 10, len(broker._threads)
+            assert any(t.name == "broker-accept" and t.is_alive()
+                       for t in broker._threads)
+        finally:
+            broker.stop()
+
     def test_topics_are_isolated(self):
         broker = StreamingBroker(port=0).start()
         try:

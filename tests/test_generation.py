@@ -1,0 +1,367 @@
+"""Continuous-batching generation serving tests (parallel/generation.py).
+
+Covers the GenerationServer contract end to end on the CPU mesh:
+correctness (greedy bit-parity with greedy_generate, sampled parity with
+sample_generate under the shared fold_in key schedule), scheduling
+(EOS/max-tokens slot retirement, occupancy churn with ZERO decode-step
+recompiles), and the PR-4 resilience posture carried over wholesale
+(deadlines queued and mid-generation, admission watermark, chaos with
+retries, typed hard-fault recovery, drain/close never leaving a hung
+future). Streaming-mask unit tests for the attention layer ride along —
+they are the layer-level property the prefill path depends on.
+"""
+
+import time
+from contextlib import contextmanager
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.zoo import (TransformerLM, greedy_generate,
+                                           lm_stream_forward,
+                                           sample_generate)
+from deeplearning4j_tpu.parallel.generation import GenerationServer
+from deeplearning4j_tpu.parallel.resilience import (ChaosPolicy,
+                                                    CircuitBreaker,
+                                                    DeadlineExceeded,
+                                                    ResilienceError,
+                                                    RetryPolicy,
+                                                    ServerOverloaded)
+
+V = 17
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return TransformerLM(num_labels=V, max_length=16, d_model=16,
+                         n_heads=2, n_blocks=1, seed=3).init()
+
+
+@pytest.fixture(scope="module")
+def greedy_refs(lm):
+    """Mixed-length request set + serial greedy references (computed while
+    no server is live, so the reference scan programs compile without a
+    concurrent cache writer)."""
+    rs = np.random.RandomState(4)
+    shapes = [(3, 6), (5, 4), (9, 5), (3, 5), (5, 6), (9, 4)]
+    reqs = [(rs.randint(0, V, p), s) for p, s in shapes]
+    refs = [greedy_generate(lm, p[None], s, V)[0] for p, s in reqs]
+    return reqs, refs
+
+
+@contextmanager
+def serving(*args, **kwargs):
+    srv = GenerationServer(*args, **kwargs)
+    try:
+        yield srv
+    finally:
+        srv.close()
+
+
+@pytest.mark.generation
+class TestGenerationCorrectness:
+    def test_greedy_parity_mixed_length_concurrent(self, lm, greedy_refs):
+        """Six concurrent requests of three prompt lengths through three
+        slots (occupancy churns as short requests retire) decode
+        BIT-identically to per-request greedy_generate."""
+        reqs, refs = greedy_refs
+        with serving(lm, V, slots=3) as srv:
+            futs = [srv.submit(p, s) for p, s in reqs]
+            outs = [f.result(timeout=120) for f in futs]
+            st = srv.stats()
+        for got, ref in zip(outs, refs):
+            np.testing.assert_array_equal(got, ref)
+        assert st["completed"] == len(reqs)
+        assert st["failed"] == 0 and st["expired"] == 0
+        assert st["prefills"] == len(reqs)
+        assert st["tokens_generated"] == sum(s for _, s in reqs)
+
+    def test_sampled_parity_and_determinism(self, lm):
+        """Sampled requests share sample_generate's per-token key schedule
+        (fold_in(PRNGKey(seed), token_index)), so the pooled batch-S path
+        reproduces the serial batch-1 path exactly; same seed twice in
+        DIFFERENT slots of one batch is also identical."""
+        rs = np.random.RandomState(5)
+        prompt = rs.randint(0, V, 4)
+        ref = sample_generate(lm, prompt[None], 6, V, temperature=0.9,
+                              top_k=5, seed=7)[0]
+        with serving(lm, V, slots=3) as srv:
+            f1 = srv.submit(prompt, 6, temperature=0.9, top_k=5, seed=7)
+            f2 = srv.submit(prompt, 6, temperature=0.9, top_k=5, seed=7)
+            a, b = f1.result(timeout=120), f2.result(timeout=120)
+        np.testing.assert_array_equal(a, ref)
+        np.testing.assert_array_equal(a, b)
+
+    def test_mixed_sampling_params_one_batch(self, lm, greedy_refs):
+        """Greedy and sampled requests coexist in one pooled batch (the
+        params are traced per-slot values): the greedy row still matches
+        its serial reference exactly."""
+        reqs, refs = greedy_refs
+        (gp, gs), gref = reqs[0], refs[0]
+        rs = np.random.RandomState(8)
+        sp = rs.randint(0, V, 5)
+        sref = sample_generate(lm, sp[None], 4, V, temperature=1.3,
+                               top_k=0, seed=11)[0]
+        with serving(lm, V, slots=3) as srv:
+            fg = srv.submit(gp, gs)
+            fs = srv.submit(sp, 4, temperature=1.3, top_k=0, seed=11)
+            np.testing.assert_array_equal(fg.result(timeout=120), gref)
+            np.testing.assert_array_equal(fs.result(timeout=120), sref)
+
+    def test_eos_retires_slot_early(self, lm, greedy_refs):
+        """A per-request eos_id truncates the output at (and including)
+        the EOS token and frees the slot; a sibling request without EOS
+        runs to max_tokens untouched."""
+        reqs, refs = greedy_refs
+        (p0, s0), ref0 = reqs[0], refs[0]
+        (p1, s1), ref1 = reqs[1], refs[1]
+        eos = int(ref0[3])
+        k = int(np.where(ref0 == eos)[0][0])        # first occurrence
+        with serving(lm, V, slots=3) as srv:
+            fe = srv.submit(p0, s0, eos_id=eos)
+            fn = srv.submit(p1, s1)
+            got = fe.result(timeout=120)
+            np.testing.assert_array_equal(fn.result(timeout=120), ref1)
+            st = srv.stats()
+        np.testing.assert_array_equal(got, ref0[:k + 1])
+        assert len(got) == k + 1 < s0               # actually truncated
+        assert st["completed"] == 2
+
+    def test_submit_validation(self, lm):
+        with serving(lm, V, slots=3) as srv:
+            with pytest.raises(ValueError, match="prompt_ids"):
+                srv.submit(np.zeros((0,), np.int64), 4)
+            with pytest.raises(ValueError, match="prompt_ids"):
+                srv.submit(np.zeros((2, 3), np.int64), 4)
+            with pytest.raises(ValueError, match="max_tokens"):
+                srv.submit(np.array([1, 2]), 0)
+            with pytest.raises(ValueError, match="temperature"):
+                srv.submit(np.array([1, 2]), 4, temperature=-1.0)
+            with pytest.raises(ValueError, match="top_k"):
+                srv.submit(np.array([1, 2]), 4, top_k=V + 1)
+            with pytest.raises(ValueError, match="capacity"):
+                srv.submit(np.array([1, 2]), 100000)
+
+    def test_rejects_model_without_kv_carry(self):
+        """GenerationServer serves explicit-KV-carry streamers; a model
+        whose streaming carry is not seedable up front fails at
+        construction, not mid-serve."""
+        from deeplearning4j_tpu.nn.conf.builders import \
+            NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                       OutputLayer)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        conf = (NeuralNetConfiguration.builder().seed(0)
+                .weight_init("xavier").activation("relu")
+                .list(DenseLayer(n_out=8),
+                      OutputLayer(n_out=3, loss="mcxent",
+                                  activation="softmax"))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        with pytest.raises(ValueError, match="KV carry"):
+            GenerationServer(net, 3, slots=2)
+
+
+@pytest.mark.generation
+class TestGenerationScheduling:
+    def test_no_recompile_on_occupancy_churn(self):
+        """The whole point of slot pooling: after warmup (ONE decode
+        program + one prefill program per pow2 prompt bucket), arbitrary
+        occupancy churn — admits, retirements, mixed lengths, idle slots
+        — adds ZERO compiled programs."""
+        net = TransformerLM(num_labels=V, max_length=16, d_model=8,
+                            n_heads=2, n_blocks=1, seed=9).init()
+        rs = np.random.RandomState(0)
+        with serving(net, V, slots=3, min_prefill_bucket=4) as srv:
+            base = len(net._output_cache)
+            warm = [srv.submit(rs.randint(0, V, 3), 5),   # bucket 4
+                    srv.submit(rs.randint(0, V, 7), 2)]   # bucket 8
+            for f in warm:
+                f.result(timeout=120)
+            warmed = len(net._output_cache)
+            # decode step + the two prefill buckets, nothing else
+            assert warmed - base == 1 + 2
+
+            churn = [(4, 3), (2, 7), (6, 1), (8, 4), (3, 2), (5, 6)]
+            futs = []
+            for plen, mt in churn:
+                futs.append(srv.submit(rs.randint(0, V, plen), mt))
+                time.sleep(0.02)  # stagger: arrive at varied occupancy
+            for f, (_plen, mt) in zip(futs, churn):
+                assert f.result(timeout=120).shape == (mt,)
+            assert len(net._output_cache) == warmed
+            st = srv.stats()
+        assert st["completed"] == 8
+        assert st["decode_steps"] > 0
+
+    def test_deadline_expired_while_queued(self, lm, greedy_refs):
+        reqs, refs = greedy_refs
+        (p0, s0), ref0 = reqs[0], refs[0]
+        with serving(lm, V, slots=3) as srv:
+            f = srv.submit(p0, s0, deadline_s=0.0)
+            with pytest.raises(DeadlineExceeded, match="queued"):
+                f.result(timeout=30)
+            # the server is unharmed: the next request serves normally
+            np.testing.assert_array_equal(
+                srv.submit(p0, s0).result(timeout=120), ref0)
+            st = srv.stats()
+        assert st["expired"] == 1 and st["completed"] == 1
+
+    def test_deadline_expired_mid_generation(self, lm, greedy_refs):
+        """A request whose budget runs out mid-decode fails typed AND
+        frees its slot — with every dispatch slowed by injected latency
+        the 200-token ask cannot finish inside 180 ms."""
+        reqs, refs = greedy_refs
+        (p0, s0), ref0 = reqs[0], refs[0]
+        chaos = ChaosPolicy(latency_rate=1.0, latency_s=0.05)
+        with serving(lm, V, slots=3, chaos=chaos) as srv:
+            f = srv.submit(p0, 200, deadline_s=0.18)
+            with pytest.raises(DeadlineExceeded):
+                f.result(timeout=30)
+            st = srv.stats()
+            assert st["expired"] == 1
+            assert st["active_slots"] == 0              # slot freed
+            chaos.latency_rate = 0.0
+            np.testing.assert_array_equal(
+                srv.submit(p0, s0).result(timeout=120), ref0)
+
+    def test_admission_watermark_sheds_load(self, lm, greedy_refs):
+        reqs, refs = greedy_refs
+        (p0, s0), ref0 = reqs[0], refs[0]
+        chaos = ChaosPolicy(latency_rate=1.0, latency_s=0.2)
+        with serving(lm, V, slots=3, max_pending=1, chaos=chaos) as srv:
+            f1 = srv.submit(p0, s0)
+            with pytest.raises(ServerOverloaded):
+                srv.submit(p0, s0)
+            np.testing.assert_array_equal(f1.result(timeout=120), ref0)
+            # admission released on resolution: capacity is back
+            chaos.latency_rate = 0.0
+            np.testing.assert_array_equal(
+                srv.submit(p0, s0).result(timeout=120), ref0)
+            st = srv.stats()
+        assert st["rejected"] == 1 and st["completed"] == 2
+
+
+@pytest.mark.generation
+class TestGenerationResilience:
+    def test_chaos_transients_retry_zero_lost_futures(self, lm,
+                                                      greedy_refs):
+        """Under a 35% transient-fault rate every future still resolves —
+        almost always to the exact greedy reference (retries), in the
+        worst case to a typed ResilienceError — and never hangs."""
+        reqs, refs = greedy_refs
+        chaos = ChaosPolicy(seed=2, transient_rate=0.35)
+        retry = RetryPolicy(max_attempts=6, base_s=0.001, cap_s=0.01,
+                            seed=0, sleep=lambda _s: None)
+        breaker = CircuitBreaker(failure_threshold=1.1)  # never trips
+        with serving(lm, V, slots=3, retry=retry, breaker=breaker,
+                     chaos=chaos) as srv:
+            futs = [srv.submit(p, s) for p, s in reqs]
+            ok = 0
+            for f, ref in zip(futs, refs):
+                try:
+                    got = f.result(timeout=120)
+                except ResilienceError:
+                    continue  # typed, not lost — acceptable under chaos
+                np.testing.assert_array_equal(got, ref)
+                ok += 1
+            st = srv.stats()
+        assert all(f.done() for f in futs)              # zero lost
+        assert ok >= 1                                  # retries do work
+        assert chaos.injected_transient > 0
+        assert st["retried"] > 0
+
+    def test_hard_decode_fault_fails_typed_and_recovers(self, lm,
+                                                        greedy_refs):
+        """A hard (non-retryable) decode fault fails the in-flight batch
+        typed, the pooled carry is rebuilt from zeros, and the next
+        request decodes correctly — the server never wedges."""
+        reqs, refs = greedy_refs
+        (p0, s0), ref0 = reqs[0], refs[0]
+        chaos = ChaosPolicy(latency_rate=1.0, latency_s=0.05)
+        breaker = CircuitBreaker(failure_threshold=1.1)
+        with serving(lm, V, slots=3, breaker=breaker, chaos=chaos) as srv:
+            f = srv.submit(p0, 200)
+            for _ in range(600):                  # wait until mid-decode
+                if srv.stats()["prefills"] >= 1:
+                    break
+                time.sleep(0.01)
+            chaos.hard_rate = 1.0                 # next dispatch dies hard
+            with pytest.raises(RuntimeError, match="hard fault"):
+                f.result(timeout=30)
+            chaos.hard_rate = 0.0
+            chaos.latency_rate = 0.0
+            np.testing.assert_array_equal(
+                srv.submit(p0, s0).result(timeout=120), ref0)
+            st = srv.stats()
+        assert st["failed"] >= 1 and st["completed"] == 1
+
+    def test_drain_resolves_everything(self, lm, greedy_refs):
+        reqs, refs = greedy_refs
+        with serving(lm, V, slots=2) as srv:
+            futs = [srv.submit(p, s) for p, s in reqs]
+            assert srv.drain(timeout=120)
+            assert all(f.done() for f in futs)
+            for f, ref in zip(futs, refs):
+                np.testing.assert_array_equal(f.result(timeout=1), ref)
+
+    def test_close_fails_stragglers_typed(self, lm):
+        """close() with work still in flight past its timeout resolves
+        the stragglers with a typed error instead of leaving hung
+        futures; submitting after close is refused."""
+        rs = np.random.RandomState(12)
+        chaos = ChaosPolicy(latency_rate=1.0, latency_s=0.25)
+        srv = GenerationServer(lm, V, slots=3, chaos=chaos)
+        f = srv.submit(rs.randint(0, V, 3), 400)
+        srv.close(timeout=0.3)
+        assert f.done()
+        with pytest.raises(RuntimeError, match="closed"):
+            f.result(timeout=1)
+        with pytest.raises(RuntimeError, match="closed"):
+            srv.submit(rs.randint(0, V, 3), 2)
+
+
+@pytest.mark.generation
+class TestStreamingMask:
+    """Layer-level property the prefill bucket path depends on: a right-
+    padded prompt with a [B, T] validity mask streams identically to the
+    unpadded prompt, and inapplicable mask shapes fail loudly."""
+
+    def _carry(self, lm, batch=1):
+        lm.rnn_clear_previous_state()
+        seed = lm._seed_streaming_carry(batch)
+        lm.rnn_clear_previous_state()
+        return seed
+
+    def test_masked_right_pad_matches_unpadded(self, lm):
+        rs = np.random.RandomState(13)
+        plen, bucket = 5, 8
+        ids = rs.randint(0, V, plen)
+        eye = np.eye(V, dtype=np.float32)
+        fwd = lm_stream_forward(lm)
+
+        x_pad = np.zeros((1, bucket, V), np.float32)
+        x_pad[0, :plen] = eye[ids]
+        mask = np.zeros((1, bucket), np.float32)
+        mask[0, :plen] = 1
+        out_pad, _ = fwd(lm.params, lm.state, x_pad, self._carry(lm), mask)
+        out_raw, _ = fwd(lm.params, lm.state, eye[ids][None],
+                         self._carry(lm), None)
+        # true positions identical; the padded tail is garbage the caller
+        # never reads (prefill samples from position plen-1 only)
+        np.testing.assert_allclose(np.asarray(out_pad)[:, :plen],
+                                   np.asarray(out_raw), atol=1e-6)
+
+    def test_bad_mask_shape_raises(self, lm):
+        rs = np.random.RandomState(14)
+        x = np.eye(V, dtype=np.float32)[rs.randint(0, V, 4)][None]
+        fwd = lm_stream_forward(lm)
+        with pytest.raises(ValueError, match="streaming attention mask"):
+            fwd(lm.params, lm.state, x, self._carry(lm),
+                np.ones((1, 4, 1), np.float32))
+        with pytest.raises(ValueError, match="streaming attention mask"):
+            fwd(lm.params, lm.state, x, self._carry(lm),
+                np.ones((2, 4), np.float32))  # batch mismatch

@@ -355,6 +355,55 @@ class TestFunctionalBranchedImport:
         np.testing.assert_allclose(np.asarray(net.output(x)), expected,
                                    atol=1e-5, rtol=1e-4)
 
+    def test_add_same_tensor_twice_imports_as_graph(self, tmp_path):
+        """``Add()([x, x])`` — a merge fed the SAME tensor twice. Inbound
+        counting must not dedup by name: two connections means branched
+        topology (-> ComputationGraph), and the forward doubles x."""
+        from deeplearning4j_tpu.modelimport import \
+            import_keras_model_and_weights
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        rs = np.random.RandomState(17)
+        W = rs.randn(4, 3).astype(np.float32) * 0.4
+        b = rs.randn(3).astype(np.float32) * 0.1
+        config = {
+            "class_name": "Model",
+            "config": {
+                "name": "m",
+                "layers": [
+                    {"class_name": "InputLayer", "name": "in0",
+                     "config": {"name": "in0",
+                                "batch_input_shape": [None, 4]},
+                     "inbound_nodes": []},
+                    {"class_name": "Add", "name": "dbl",
+                     "config": {"name": "dbl"},
+                     "inbound_nodes": [[["in0", 0, 0], ["in0", 0, 0]]]},
+                    {"class_name": "Dense", "name": "out",
+                     "config": {"name": "out", "units": 3,
+                                "activation": "softmax"},
+                     "inbound_nodes": [[["dbl", 0, 0]]]},
+                ],
+                "input_layers": [["in0", 0, 0]],
+                "output_layers": [["out", 0, 0]],
+            },
+        }
+        path = str(tmp_path / "add_same.h5")
+        with h5py.File(path, "w") as f:
+            f.attrs["model_config"] = json.dumps(config)
+            mw = f.create_group("model_weights")
+            g = mw.create_group("out")
+            g.attrs["weight_names"] = [b"out_W", b"out_b"]
+            g.create_dataset("out_W", data=W)
+            g.create_dataset("out_b", data=b)
+        net = import_keras_model_and_weights(path)
+        assert isinstance(net, ComputationGraph)
+        x = rs.randn(5, 4).astype(np.float32)
+        logits = (2.0 * x) @ W + b
+        e = np.exp(logits - logits.max(axis=1, keepdims=True))
+        expected = e / e.sum(axis=1, keepdims=True)
+        np.testing.assert_allclose(np.asarray(net.output(x)), expected,
+                                   atol=1e-5, rtol=1e-4)
+
     def test_shared_layer_rejected(self, tmp_path):
         from deeplearning4j_tpu.modelimport import \
             import_keras_model_and_weights

@@ -163,7 +163,34 @@ class TestWord2Vec:
             built(negative=0, use_hierarchic_softmax=True,
                   backend="native")._use_native_backend()
 
-    def test_cbow_learns_topic_structure(self):
+    def test_native_fallback_reuses_materialized_corpus(self, monkeypatch):
+        """Regression: when the native kernel bails (returns None) AFTER
+        the corpus walk consumed a one-shot generator, the device
+        fallback must train on the materialized index corpus — re-
+        iterating the exhausted generator would silently train on
+        nothing."""
+        import deeplearning4j_tpu.native as native
+
+        corpus = _synthetic_corpus(80)
+        w2v = Word2Vec(layer_size=16, window=3, min_word_frequency=2,
+                       epochs=1, negative=5, use_hierarchic_softmax=False,
+                       learning_rate=0.05, seed=5)
+        w2v.build_vocab(corpus)
+        w2v.reset_weights()
+        before = np.array(w2v.syn0, copy=True)
+
+        monkeypatch.setattr(native, "skipgram_train",
+                            lambda *a, **k: None)
+        trained_tokens = []
+        orig_fit = w2v._fit_element_epochs
+        w2v._fit_element_epochs = lambda sents: (
+            trained_tokens.append(sum(len(s) for s in sents))
+            or orig_fit(sents))
+
+        one_shot = iter(corpus)          # no .reset(): a plain generator
+        w2v._fit_native(one_shot)
+        assert trained_tokens and trained_tokens[0] > 0
+        assert not np.allclose(np.asarray(w2v.syn0), before)
         corpus = _synthetic_corpus()
         w2v = Word2Vec(layer_size=32, window=4, min_word_frequency=3,
                        epochs=6, negative=5, use_hierarchic_softmax=False,
