@@ -1088,6 +1088,19 @@ def main():
         d4j.enable_compile_cache(os.path.join(
             os.path.dirname(os.path.abspath(__file__)), ".xla_cache"))
     extras = {}
+    # informational, never gating: the graftcheck finding trajectory
+    # (total / baselined / unbaselined) so BENCH_r06+ shows whether the
+    # audited-unsafe list is shrinking or quietly growing
+    try:
+        from deeplearning4j_tpu.analysis import run_check
+        _rep = run_check()
+        extras["analysis_findings"] = len(_rep.findings)
+        extras["analysis_unbaselined"] = len(_rep.unbaselined)
+        print(f"# analysis_findings {len(_rep.findings)} "
+              f"({len(_rep.unbaselined)} unbaselined, "
+              f"{len(_rep.baselined)} baselined)", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — the bench must never die on it
+        print(f"# analysis_findings FAILED: {e}", file=sys.stderr)
     headline = _HeadlineSampler() if which in ("all", "resnet50") else None
     if headline is not None:
         headline.start()

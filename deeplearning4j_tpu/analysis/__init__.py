@@ -1,0 +1,18 @@
+"""graftcheck: AST-based JAX-hazard + concurrency static analysis.
+
+Run it::
+
+    python -m deeplearning4j_tpu.analysis --check
+
+Programmatic entry points live in :mod:`deeplearning4j_tpu.analysis.core`
+(:func:`~deeplearning4j_tpu.analysis.core.run_check`), the rule families
+in :mod:`~deeplearning4j_tpu.analysis.jax_rules` and
+:mod:`~deeplearning4j_tpu.analysis.concurrency_rules`, and the opt-in
+runtime lock-order assertion in
+:mod:`~deeplearning4j_tpu.analysis.instrument`.
+"""
+
+from deeplearning4j_tpu.analysis.core import (Baseline, Finding, Report,
+                                              analyze, run_check)
+
+__all__ = ["Baseline", "Finding", "Report", "analyze", "run_check"]

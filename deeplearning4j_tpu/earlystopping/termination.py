@@ -86,15 +86,18 @@ class ScoreImprovementEpochTerminationCondition(EpochTerminationCondition):
 
 @dataclass
 class MaxTimeIterationTerminationCondition(IterationTerminationCondition):
-    """Wall-clock budget (reference: MaxTimeIterationTerminationCondition.java)."""
+    """Elapsed-time budget, measured on the monotonic clock (reference:
+    MaxTimeIterationTerminationCondition.java)."""
 
     max_seconds: float
 
     def initialize(self) -> None:
-        self._start = time.time()
+        # monotonic: a wall-clock step (NTP, VM migration) must neither
+        # fire termination early nor extend the budget
+        self._start = time.monotonic()
 
     def terminate(self, last_score: float) -> bool:
-        return (time.time() - self._start) >= self.max_seconds
+        return (time.monotonic() - self._start) >= self.max_seconds
 
     def __str__(self):
         return f"MaxTimeIterationTerminationCondition({self.max_seconds}s)"

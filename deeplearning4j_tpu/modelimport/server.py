@@ -293,9 +293,9 @@ class KerasBackendServer:
         out.update(accepted=self.admission.accepted,
                    rejected=self.admission.rejected,
                    pending=self.admission.pending,
-                   breaker_state=self.breaker.state,
-                   models=len(self._models))
+                   breaker_state=self.breaker.state)
         with self._lock:
+            out["models"] = len(self._models)
             gens = dict(self._generators)
         if gens:
             out["generation"] = {mid: g.stats() for mid, g in gens.items()}

@@ -64,8 +64,16 @@ class CheckpointStore:
         # atomic rename (the exact crash window this store exists for) —
         # but only STALE ones: another live writer sharing the directory
         # finishes its save in seconds, so an age gate keeps the sweep from
-        # unlinking an in-flight file under it
-        now = time.time()
+        # unlinking an in-flight file under it. "now" is measured on the
+        # filesystem's own clock (a fresh probe file's mtime) so it is
+        # self-consistent with the candidates' mtimes even when the wall
+        # clock steps between the writer and this sweep.
+        fd, probe = tempfile.mkstemp(suffix=".probe", dir=directory)
+        try:
+            os.close(fd)
+            now = os.path.getmtime(probe)
+        finally:
+            os.unlink(probe)
         for name in os.listdir(directory):
             if name.endswith(".tmp"):
                 path = os.path.join(directory, name)
@@ -434,11 +442,21 @@ class Heartbeat:
 
 
 class FailureDetector:
-    """Scan a directory of heartbeat files for stalled/dead workers."""
+    """Scan a directory of heartbeat files for stalled/dead workers.
+
+    Staleness is CHANGE-detected on the observer's monotonic clock: the
+    persisted wall-clock ``ts`` acts as a version number, and a worker is
+    dead once its ``ts`` has not advanced for ``timeout`` seconds of
+    *observer* time. Comparing the writer's wall clock against the
+    observer's (the old scheme) declares every worker dead the moment
+    either clock steps under NTP/VM migration."""
 
     def __init__(self, directory: str, timeout: float = 10.0):
         self.directory = directory
         self.timeout = timeout
+        # worker -> (last persisted ts seen, observer-monotonic instant
+        # at which that value was first observed)
+        self._observed: dict = {}
 
     def workers(self) -> dict:
         out = {}
@@ -455,10 +473,27 @@ class FailureDetector:
         return out
 
     def dead_workers(self, now: Optional[float] = None) -> list:
-        now = time.time() if now is None else now
+        """Workers whose heartbeat has not advanced for ``timeout``
+        observer-seconds (or whose file is unreadable). ``now`` overrides
+        the observer's ``time.monotonic()`` reading — test hook."""
+        mono = time.monotonic() if now is None else now
+        seen = self.workers()
+        # forget workers whose heartbeat file vanished, so a re-created
+        # one starts a fresh staleness window
+        self._observed = {w: v for w, v in self._observed.items()
+                          if w in seen}
         dead = []
-        for worker, info in self.workers().items():
-            if info is None or now - info.get("ts", 0) > self.timeout:
+        for worker, info in seen.items():
+            if info is None:
+                dead.append(worker)
+                continue
+            ts = info.get("ts", 0)
+            prev = self._observed.get(worker)
+            if prev is None or prev[0] != ts:
+                # first observation, or the persisted ts advanced since
+                # the last scan: liveness proven on the observer's clock
+                self._observed[worker] = (ts, mono)
+            elif mono - prev[1] > self.timeout:
                 dead.append(worker)
         return sorted(dead)
 

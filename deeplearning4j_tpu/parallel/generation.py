@@ -291,8 +291,9 @@ class GenerationServer:
                     f"prompt + generated positions ({needed}) exceed the "
                     f"KV-cache capacity ({self._capacity}); raise "
                     "SelfAttentionLayer.max_cache or lower max_tokens")
-        if self._closing:
-            raise RuntimeError("GenerationServer is closed")
+        with self._cond:
+            if self._closing:
+                raise RuntimeError("GenerationServer is closed")
         if not self.breaker.allow():
             raise CircuitOpen("circuit breaker is open: recent decode "
                               "dispatches failed above threshold")
@@ -324,10 +325,13 @@ class GenerationServer:
                     continue
             try:
                 self._admit_free_slots()
-                if self._n_active:
+                with self._cond:
+                    n_active = self._n_active
+                if n_active:
                     t0 = time.monotonic()
                     self._decode_once()
-                    self._busy_s += time.monotonic() - t0
+                    with self._cond:
+                        self._busy_s += time.monotonic() - t0
                 self._expire_active()
             except Exception as e:  # noqa: BLE001 — a loop death would
                 # hang every outstanding future; fail them typed instead
@@ -397,8 +401,6 @@ class GenerationServer:
         new_pool, first = self.retry.call(attempt, deadline=req.deadline,
                                           on_retry=self._count_retry)
         self._carry = new_pool
-        self._busy_s += time.monotonic() - t0
-        self._prefills += 1
         tok = int(first)
         self._last[slot] = tok
         self._counts[slot] = 1
@@ -407,12 +409,14 @@ class GenerationServer:
         self._keys[slot] = base_key
         req.tokens.append(tok)
         with self._cond:
+            self._busy_s += time.monotonic() - t0
+            self._prefills += 1
             self._slot_req[slot] = req
             self._n_active += 1
             self._admitted += 1
             self._tokens += 1
         if self._finished(req, tok):
-            self._retire(slot)
+            self._retire(slot, req)
 
     def _decode_once(self):
         prog = self._decode_program()
@@ -439,8 +443,8 @@ class GenerationServer:
             self._fail_all(e)
             return
         self._carry = new_carry
-        self._decode_steps += 1
         toks = np.asarray(nxt)
+        ntok = 0
         for s in range(self.slots):
             req = self._slot_req[s]
             if req is None:
@@ -449,18 +453,20 @@ class GenerationServer:
             req.tokens.append(tok)
             self._counts[s] += 1
             self._last[s] = tok
-            with self._cond:
-                self._tokens += 1
+            ntok += 1
             if self._finished(req, tok):
-                self._retire(s)
+                self._retire(s, req)
+        # ONE condition acquisition per decode step, not one per token
+        with self._cond:
+            self._decode_steps += 1
+            self._tokens += ntok
 
     def _finished(self, req: _Request, tok: int) -> bool:
         if req.eos_id is not None and tok == req.eos_id:
             return True
         return len(req.tokens) >= req.max_tokens
 
-    def _retire(self, slot: int):
-        req = self._slot_req[slot]
+    def _retire(self, slot: int, req: _Request):
         with self._cond:
             self._slot_req[slot] = None
             self._n_active -= 1

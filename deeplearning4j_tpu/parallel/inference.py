@@ -171,7 +171,8 @@ class ParallelInference:
         fwd = self._get_fwd(x.shape, mask is not None)
         out = fwd(self.net.params, self.net.state, jnp.asarray(x),
                   jnp.asarray(mask) if mask is not None else None)
-        self.dispatch_count += 1
+        with self._stats_lock:
+            self.dispatch_count += 1
         return out
 
     # ---------------------------------------------------------- sync entry
@@ -198,10 +199,12 @@ class ParallelInference:
         ``ServerOverloaded`` when ``max_pending`` requests are in flight
         and ``CircuitOpen`` while the breaker is open — both immediately,
         never by blocking the caller."""
-        if self._closed or self._draining:
-            raise RuntimeError("ParallelInference is closed"
-                               if self._closed else
-                               "ParallelInference is draining")
+        with self._lock:
+            if self._closed or self._draining:
+                raise RuntimeError("ParallelInference is closed"
+                                   if self._closed else
+                                   "ParallelInference is draining")
+            submit_q = self._ensure_workers()
         if self.breaker is not None and not self.breaker.allow():
             with self._stats_lock:
                 self._rejected_circuit += 1
@@ -218,9 +221,10 @@ class ParallelInference:
         # (result, typed failure, shutdown drain), so pending can never
         # leak no matter which thread resolves the future
         req.future.add_done_callback(self._on_done)
-        self._ensure_workers()
-        self._submit_q.put(req)
-        if self._closed and not req.future.done():
+        submit_q.put(req)
+        with self._lock:
+            closed = self._closed
+        if closed and not req.future.done():
             # close() raced this submit past the _closed check above: the
             # request may sit behind the shutdown sentinel (or behind
             # close()'s queue drain) where no thread will ever serve it —
@@ -247,11 +251,11 @@ class ParallelInference:
             out = {"retried": self._retried, "expired": self._expired,
                    "rejected_circuit": self._rejected_circuit,
                    "completed": self._completed, "failed": self._failed}
+            out["dispatches"] = self.dispatch_count
         out.update(
             accepted=self.admission.accepted,
             rejected=self.admission.rejected,
             pending=self.admission.pending,
-            dispatches=self.dispatch_count,
             breaker_state=(self.breaker.state if self.breaker is not None
                            else "disabled"))
         return out
@@ -265,23 +269,27 @@ class ParallelInference:
         except Exception:  # noqa: BLE001 — already resolved, either way
             pass
 
-    def _ensure_workers(self):
-        if self._threads:
-            return
-        with self._lock:
-            if self._threads:
-                return
+    def _ensure_workers(self) -> queue.Queue:
+        """Start the coalescer/completer once and return the submit
+        queue. Caller must hold ``self._lock``; the worker loops receive
+        their queues as arguments so they never re-read the attributes
+        outside it."""
+        if not self._threads:
             self._submit_q = queue.Queue()
             # bounded: backpressures the coalescer when `inflight` batches
             # are dispatched but not yet fetched
             self._inflight_q = queue.Queue(maxsize=self.inflight)
-            coalescer = threading.Thread(target=self._coalesce_loop,
-                                         name="pi-coalescer", daemon=True)
-            completer = threading.Thread(target=self._complete_loop,
-                                         name="pi-completer", daemon=True)
+            coalescer = threading.Thread(
+                target=self._coalesce_loop,
+                args=(self._submit_q, self._inflight_q),
+                name="pi-coalescer", daemon=True)
+            completer = threading.Thread(
+                target=self._complete_loop, args=(self._inflight_q,),
+                name="pi-completer", daemon=True)
             self._threads = [coalescer, completer]
             coalescer.start()
             completer.start()
+        return self._submit_q
 
     def _expire_if_dead(self, req) -> bool:
         """Fail an already-expired request with DeadlineExceeded (True),
@@ -304,14 +312,13 @@ class ParallelInference:
         still lands BEFORE expiry instead of exactly on it."""
         return d.expires_at - 0.25 * max(0.0, d.remaining())
 
-    def _coalesce_loop(self):
-        q = self._submit_q
+    def _coalesce_loop(self, q: queue.Queue, inflight_q: queue.Queue):
         head = None
         while True:
             first = head if head is not None else q.get()
             head = None
             if first is _SHUTDOWN:
-                self._inflight_q.put(_SHUTDOWN)
+                inflight_q.put(_SHUTDOWN)
                 return
             if self._expire_if_dead(first):
                 continue
@@ -341,13 +348,13 @@ class ParallelInference:
                 rows += nxt.n
                 if nxt.deadline is not None:
                     deadline = min(deadline, self._flush_by(nxt.deadline))
-            self._dispatch_batch(batch)
+            self._dispatch_batch(batch, inflight_q)
 
     def _count_retry(self, attempt, exc) -> None:
         with self._stats_lock:
             self._retried += 1
 
-    def _dispatch_batch(self, batch):
+    def _dispatch_batch(self, batch, inflight_q: queue.Queue):
         # last expiry gate: members that died waiting in the assembly
         # window fail typed here, before any padding or device work
         batch = [r for r in batch if not self._expire_if_dead(r)]
@@ -385,11 +392,11 @@ class ParallelInference:
             return
         # blocks when `inflight` batches are already pending — bounded
         # pipeline: device compute overlaps the NEXT batch's host assembly
-        self._inflight_q.put((out, batch))
+        inflight_q.put((out, batch))
 
-    def _complete_loop(self):
+    def _complete_loop(self, inflight_q: queue.Queue):
         while True:
-            item = self._inflight_q.get()
+            item = inflight_q.get()
             if item is _SHUTDOWN:
                 return
             out, batch = item
@@ -415,11 +422,13 @@ class ParallelInference:
         pass first (in-flight work keeps completing either way). The first
         phase of ``close()``; also usable alone for zero-loss handoff
         (drain, swap weights/process, resume)."""
-        self._draining = True
+        with self._lock:
+            self._draining = True
+            threads = list(self._threads)
         limit = None if timeout is None else time.monotonic() + timeout
         with self._drain_cv:
             while self.admission.pending > 0:
-                if not any(t.is_alive() for t in self._threads):
+                if not any(t.is_alive() for t in threads):
                     # no worker will ever resolve the remainder (crashed
                     # coalescer, or staged shutdown tests): close()'s
                     # behind-sentinel queue drain owns those requests
@@ -437,7 +446,9 @@ class ParallelInference:
         complete before the threads exit; requests that raced the shutdown
         in behind the sentinel are FAILED with RuntimeError, never left
         unresolved."""
-        if not self._closed and self._threads:
+        with self._lock:
+            should_drain = not self._closed and bool(self._threads)
+        if should_drain:
             self.drain(timeout)
         with self._lock:
             if self._closed:

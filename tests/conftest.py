@@ -148,3 +148,28 @@ def pytest_configure(config):
         "markers",
         "allow_output_recompiles: opt out of the per-test inference "
         "recompile-count guard")
+    config.addinivalue_line(
+        "markers",
+        "analysis: graftcheck static-analyzer tests (AST rules, baseline "
+        "gate, lock-order instrumentation — CPU-fast; the zero-unbaselined"
+        "-findings gate runs in tier-1, deliberately NOT in the slow set)")
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_debug(request):
+    """Opt-in runtime lock-order assertion: with DL4J_TPU_LOCK_DEBUG=1,
+    tests under the serving/generation markers run with the serving
+    locks wrapped in rank-checked OrderedLocks (analysis/instrument.py),
+    so any out-of-order acquisition fails the test instead of deadlocking
+    in production."""
+    if os.environ.get("DL4J_TPU_LOCK_DEBUG") != "1" or not (
+            request.node.get_closest_marker("serving")
+            or request.node.get_closest_marker("generation")):
+        yield
+        return
+    from deeplearning4j_tpu.analysis import instrument
+    instrument.install()
+    try:
+        yield
+    finally:
+        instrument.uninstall()

@@ -1,0 +1,74 @@
+"""Must-NOT-flag cases for the concurrency rules (graftcheck fixture —
+never imported, only parsed)."""
+import threading
+import time
+
+
+class DisciplinedServer:
+    """Clean lock discipline: no conc-mixed-lock, no blocking findings."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0      # always accessed under the lock
+        self._config = {}    # written only in __init__, read-only after
+        self._done = []      # mutated only via _retire (callers hold lock)
+
+    def incr(self):
+        with self._lock:
+            self._count += 1
+
+    def snapshot(self):
+        with self._lock:
+            # NEGATIVE conc-mixed-lock: every access is locked
+            return self._count
+
+    def lookup(self, k):
+        with self._lock:
+            # NEGATIVE conc-lock-blocking-call: dict.get, not queue.get
+            return self._config.get(k)
+
+    def describe(self):
+        # NEGATIVE conc-mixed-lock: init-only write + read-only use
+        return ", ".join(sorted(self._config))
+
+    def _retire(self, x):
+        # NEGATIVE conc-mixed-lock: private method — entry-lock
+        # propagation sees every call site holds self._lock
+        self._done.append(x)
+
+    def finish(self, x):
+        with self._lock:
+            self._retire(x)
+
+    def render(self, names):
+        with self._lock:
+            # NEGATIVE conc-lock-blocking-call: str.join is not
+            # thread.join
+            return ", ".join(names)
+
+
+class CondOwner:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._ready = False
+
+    def wait_ready(self):
+        with self._cv:
+            while not self._ready:
+                # NEGATIVE conc-lock-blocking-call: waiting on the
+                # condition you HOLD releases it — that is the point
+                self._cv.wait(timeout=0.1)
+            return True
+
+    def set_ready(self):
+        with self._cv:
+            self._ready = True
+            self._cv.notify_all()
+
+
+def record_heartbeat(path):
+    # NEGATIVE monotonic-deadline: storing a wall timestamp (no
+    # arithmetic) is legitimate — it is data, not a duration
+    stamp = time.time()
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(str(stamp))

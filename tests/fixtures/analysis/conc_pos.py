@@ -1,0 +1,95 @@
+"""Must-flag cases for the concurrency rules (graftcheck fixture —
+never imported, only parsed)."""
+import threading
+import time
+
+
+class MixedCounter:
+    """Three conc-mixed-lock positives: `_count`, `_state`, `_items`."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._state = "idle"
+        self._items = []
+
+    def incr(self):
+        with self._lock:
+            self._count += 1
+
+    def read_fast(self):
+        # POSITIVE conc-mixed-lock: unlocked read racing the locked writer
+        return self._count
+
+    def set_state(self, s):
+        # POSITIVE conc-mixed-lock: unlocked write, locked reader below
+        self._state = s
+
+    def get_state(self):
+        with self._lock:
+            return self._state
+
+    def push(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def drain(self):
+        # POSITIVE conc-mixed-lock: unlocked container read + mutation
+        out = list(self._items)
+        self._items.clear()
+        return out
+
+
+class BlockingHolder:
+    """Four conc-lock-blocking-call positives."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._results = {}
+
+    def wait_result(self, fut):
+        with self._lock:
+            # POSITIVE conc-lock-blocking-call: Future.result under lock
+            return fut.result()
+
+    def pull(self, work_q):
+        with self._lock:
+            # POSITIVE conc-lock-blocking-call: queue.get under lock
+            return work_q.get(timeout=1.0)
+
+    def cross_wait(self, other_cv):
+        with self._lock:
+            # POSITIVE conc-lock-blocking-call: waiting on a DIFFERENT
+            # condition than the lock held
+            other_cv.wait(timeout=0.1)
+
+    def nap(self):
+        with self._lock:
+            # POSITIVE conc-lock-blocking-call: sleep under lock
+            time.sleep(0.05)
+
+
+class WallDeadline:
+    def __init__(self, budget):
+        self.budget = budget
+        self._start = time.time()
+
+    def expired(self):
+        # POSITIVE monotonic-deadline: duration math on wall clock
+        return (time.time() - self._start) > self.budget
+
+
+def wall_loop(tasks, budget):
+    start = time.time()
+    for t in tasks:
+        if time.time() - start > budget:  # POSITIVE monotonic-deadline
+            break
+        t()
+
+
+def wall_assigned(budget):
+    t0 = time.time()
+    # POSITIVE monotonic-deadline: arithmetic on a name assigned from
+    # time.time() in the same function
+    deadline = t0 + budget
+    return deadline

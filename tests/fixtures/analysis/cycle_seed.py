@@ -1,0 +1,38 @@
+"""Deliberately seeded lock-order cycle between a broker and a
+generation server (graftcheck fixture — never imported, only parsed).
+
+The cycle detector must fail loudly on this file, naming BOTH
+acquisition sites: broker holds ``_lock`` while entering the generator's
+``_cond``, and the generator holds ``_cond`` while entering the broker's
+``_lock``."""
+import threading
+
+
+class StreamingBroker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.gen = GenerationServer()
+
+    def publish(self, item):
+        with self._lock:
+            # edge: StreamingBroker._lock -> GenerationServer._cond
+            self.gen.step(item)
+
+    def accept(self, item):
+        with self._lock:
+            return item
+
+
+class GenerationServer:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self.broker = StreamingBroker()
+
+    def step(self, item):
+        with self._cond:
+            return item
+
+    def flush(self):
+        with self._cond:
+            # edge: GenerationServer._cond -> StreamingBroker._lock
+            self.broker.publish(None)

@@ -1,0 +1,69 @@
+"""Must-NOT-flag cases for the JAX rules, including the known-tricky
+negatives (graftcheck fixture — never imported, only parsed)."""
+from functools import partial
+
+import jax
+import numpy as np
+
+
+def make_step(steps):
+    # TRICKY NEGATIVE jax-retrace-hazard: `steps` is closure CONFIG —
+    # fixed at trace time, the if is resolved once (models/zoo.py
+    # generate() does exactly this)
+    @jax.jit
+    def step(x):
+        if steps == 1:
+            return x
+        return x * steps
+
+    return step
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def static_name_branch(x, mode):
+    # NEGATIVE jax-retrace-hazard: `mode` is declared static
+    if mode == "fast":
+        return x
+    return x * 2
+
+
+@jax.jit
+def none_check(x, mask):
+    # TRICKY NEGATIVE jax-retrace-hazard: `is None` is concrete at
+    # trace time (the pytree structure, not the traced value)
+    if mask is None:
+        return x
+    return x * mask
+
+
+@jax.jit
+def shape_branch(x):
+    # NEGATIVE jax-retrace-hazard: .shape/.ndim are trace-time statics
+    if x.shape[0] > 4 and x.ndim == 2:
+        return x.sum(axis=0)
+    for i in range(x.shape[0]):  # static bound: unrolled ONCE per shape
+        x = x + i
+    return x
+
+
+def trace_time_noise(key):
+    # NEGATIVE jax-untraced-randomness: np.random OUTSIDE jitted code
+    init = np.random.normal(size=3)
+
+    @jax.jit
+    def step(x):
+        return x + jax.random.normal(key, (3,))  # sanctioned path
+
+    return step(init)
+
+
+def donation_rebound(buf, x):
+    step = jax.jit(lambda b, v: b + v, donate_argnums=(0,))
+    buf = step(buf, x)  # NEGATIVE jax-donation-misuse: rebound first
+    return buf.sum()
+
+
+def summarize(state, xs):
+    # NEGATIVE jax-host-sync-in-hot-loop: not a hot-loop function name —
+    # a one-off fetch at epoch end is fine
+    return float(state.loss) + np.asarray(xs).sum()

@@ -1,0 +1,74 @@
+"""Must-flag cases for every JAX rule (graftcheck fixture — never
+imported, only parsed)."""
+import random
+
+import jax
+import numpy as np
+
+
+def retrace_if(x, threshold):
+    # POSITIVE jax-retrace-hazard: Python `if` on a traced scalar
+    if threshold > 0:
+        return x * threshold
+    return x
+
+
+retrace_if_j = jax.jit(retrace_if)
+
+
+@jax.jit
+def retrace_while(x, n):
+    # POSITIVE jax-retrace-hazard: `while` on a traced value
+    while n > 0:
+        x = x + 1
+        n = n - 1
+    return x
+
+
+@jax.jit
+def retrace_range(x, n):
+    # POSITIVE jax-retrace-hazard: range() over a traced bound unrolls
+    # per value
+    acc = x
+    for _ in range(n):
+        acc = acc + 1
+    return acc
+
+
+@jax.jit
+def baked_noise(x):
+    # POSITIVE jax-untraced-randomness: runs ONCE at trace time
+    return x + np.random.normal(size=3)
+
+
+@jax.jit
+def baked_choice(x):
+    # POSITIVE jax-untraced-randomness: stdlib random inside a trace
+    return x * random.random()
+
+
+def varying_capture(xs):
+    total = 0.0
+    for scale in xs:
+
+        def step(v):
+            return v * scale  # POSITIVE jax-varying-capture
+
+        total += jax.jit(step)(1.0)
+    return total
+
+
+def donation_read_after(buf, x):
+    step = jax.jit(lambda b, v: b + v, donate_argnums=(0,))
+    out = step(buf, x)
+    # POSITIVE jax-donation-misuse: buf's buffer may already be reused
+    return out, buf.sum()
+
+
+def _decode_once(state, xs):
+    # hot-loop function name: every one of these is a per-iteration
+    # device->host sync
+    a = state.val.item()          # POSITIVE jax-host-sync-in-hot-loop
+    b = float(state.loss)         # POSITIVE jax-host-sync-in-hot-loop
+    c = np.asarray(xs)            # POSITIVE jax-host-sync-in-hot-loop
+    return a + b + c.sum()

@@ -1,0 +1,215 @@
+"""graftcheck tests: the CI gate, the baseline contract, fixture-driven
+positive/negative coverage per rule family, the seeded lock-order cycle,
+and the runtime OrderedLock instrumentation."""
+import json
+import os
+
+import pytest
+
+import deeplearning4j_tpu
+from deeplearning4j_tpu.analysis import Baseline, analyze, run_check
+from deeplearning4j_tpu.analysis import instrument
+from deeplearning4j_tpu.analysis.instrument import (LockOrderViolation,
+                                                    OrderedCondition,
+                                                    OrderedLock)
+
+pytestmark = pytest.mark.analysis
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "analysis")
+PKG = os.path.dirname(os.path.abspath(deeplearning4j_tpu.__file__))
+
+
+def _scan(*names, baseline=None):
+    files = [os.path.join(FIXTURES, n) for n in names]
+    return analyze(root=FIXTURES, files=files, baseline=baseline)
+
+
+# ---------------------------------------------------------------------------
+# the gate: the shipped tree must analyze clean against the audited baseline
+# ---------------------------------------------------------------------------
+
+def test_gate_zero_unbaselined_findings():
+    rep = run_check()
+    assert rep.parse_errors == []
+    assert [f.render() for f in rep.unbaselined] == []
+    assert rep.stale_baseline == []
+    assert rep.files_scanned > 100  # the whole package was actually walked
+
+
+def test_server_stats_lock_discipline_is_clean():
+    # satellite: after the _stats_lock fix, KerasBackendServer has ZERO
+    # mixed-access attributes
+    rep = analyze(root=PKG,
+                  files=[os.path.join(PKG, "modelimport", "server.py")])
+    mixed = [f for f in rep.findings
+             if f.rule == "conc-mixed-lock" and f.scope == "KerasBackendServer"]
+    assert mixed == []
+
+
+# ---------------------------------------------------------------------------
+# baseline contract
+# ---------------------------------------------------------------------------
+
+def test_baseline_entry_requires_justification(tmp_path):
+    p = tmp_path / "baseline.json"
+    p.write_text(json.dumps(
+        {"entries": [{"key": "r::p::s::d", "justification": "   "}]}),
+        encoding="utf-8")
+    with pytest.raises(ValueError, match="justification"):
+        Baseline.load(str(p))
+
+
+def test_baseline_entry_requires_key(tmp_path):
+    p = tmp_path / "baseline.json"
+    p.write_text(json.dumps({"entries": [{"justification": "why"}]}),
+                 encoding="utf-8")
+    with pytest.raises(ValueError, match="key"):
+        Baseline.load(str(p))
+
+
+def test_stale_baseline_entries_are_reported():
+    bl = Baseline(entries={"no-such-rule::x.py::S::d": "obsolete"})
+    rep = _scan("conc_neg.py", baseline=bl)
+    assert rep.stale_baseline == ["no-such-rule::x.py::S::d"]
+
+
+def test_baseline_splits_findings():
+    rep = _scan("conc_pos.py")
+    key = next(f.key for f in rep.findings if f.rule == "conc-mixed-lock")
+    rep2 = _scan("conc_pos.py", baseline=Baseline(entries={key: "audited"}))
+    assert [f.key for f in rep2.baselined] == [key]
+    assert key not in {f.key for f in rep2.unbaselined}
+    assert len(rep2.unbaselined) == len(rep.findings) - 1
+
+
+# ---------------------------------------------------------------------------
+# JAX rule family: positives (must flag) and negatives (must not)
+# ---------------------------------------------------------------------------
+
+def test_jax_rules_positives():
+    rep = _scan("jax_pos.py")
+    got = {(f.rule, f.detail) for f in rep.findings}
+    # retrace hazards: if / while / range on traced values
+    assert ("jax-retrace-hazard", "retrace_if:if:threshold") in got
+    assert ("jax-retrace-hazard", "retrace_while:while:n") in got
+    assert ("jax-retrace-hazard", "retrace_range:range:n") in got
+    # randomness baked in at trace time
+    assert ("jax-untraced-randomness", "baked_noise:np.random.normal") in got
+    assert ("jax-untraced-randomness", "baked_choice:random.random") in got
+    # closure capture that varies per call
+    assert ("jax-varying-capture", "step:scale") in got
+    # donated buffer read after the donating dispatch
+    assert ("jax-donation-misuse", "donation_read_after:buf") in got
+    # per-iteration host syncs in a hot-loop function
+    sync = {d for (r, d) in got if r == "jax-host-sync-in-hot-loop"}
+    assert {"_decode_once:.item():1", "_decode_once:float():1",
+            "_decode_once:np.asarray:1"} <= sync
+
+
+def test_jax_rules_negatives():
+    # includes the known-tricky negative: a Python `if` on a CLOSURE
+    # CONFIG value inside a jitted fn (make_step) must NOT flag
+    rep = _scan("jax_neg.py")
+    assert [f.render() for f in rep.findings] == []
+
+
+# ---------------------------------------------------------------------------
+# concurrency rule family: positives and negatives
+# ---------------------------------------------------------------------------
+
+def test_concurrency_rules_positives():
+    rep = _scan("conc_pos.py")
+    mixed = {f.detail for f in rep.findings if f.rule == "conc-mixed-lock"}
+    assert mixed == {"_count", "_state", "_items"}
+
+    blocking = {f.detail for f in rep.findings
+                if f.rule == "conc-lock-blocking-call"}
+    assert blocking == {"wait_result:.result()",
+                        "pull:.get() on queue `work_q`",
+                        "cross_wait:.wait() on `other_cv`",
+                        "nap:time.sleep()"}
+
+    mono = {f.detail for f in rep.findings if f.rule == "monotonic-deadline"}
+    assert mono == {"expired:time.time()", "wall_loop:time.time()",
+                    "wall_assigned:t0"}
+
+
+def test_concurrency_rules_negatives():
+    # always-locked attrs, init-only reads, entry-lock propagation into a
+    # private method, str.join / dict.get under lock, wait on the HELD
+    # condition, plain wall-timestamp store: all clean
+    rep = _scan("conc_neg.py")
+    assert [f.render() for f in rep.findings] == []
+
+
+def test_seeded_lock_cycle_names_both_sites():
+    # acceptance criterion: a deliberate broker<->generation lock-order
+    # cycle fails loudly, naming BOTH acquisition sites
+    rep = _scan("cycle_seed.py")
+    cycles = [f for f in rep.findings if f.rule == "conc-lock-cycle"]
+    assert len(cycles) == 1
+    msg = cycles[0].message
+    assert "StreamingBroker._lock" in msg
+    assert "GenerationServer._cond" in msg
+    import re
+    sites = re.findall(r"acquired at (analysis/cycle_seed\.py:\d+)", msg)
+    assert len(sites) == 2 and sites[0] != sites[1]
+
+
+# ---------------------------------------------------------------------------
+# runtime half: OrderedLock / OrderedCondition
+# ---------------------------------------------------------------------------
+
+def test_ordered_lock_ascending_order_ok():
+    a, b = OrderedLock(10, "a"), OrderedLock(20, "b")
+    with a:
+        with b:
+            assert b.locked()
+    with b:  # stack fully unwound between uses
+        pass
+
+
+def test_ordered_lock_out_of_order_raises():
+    a, b = OrderedLock(10, "a"), OrderedLock(20, "b")
+    with b:
+        with pytest.raises(LockOrderViolation, match="rank"):
+            with a:
+                pass
+    with a:  # failed acquire left the rank stack clean
+        pass
+
+
+def test_ordered_condition_wait_releases_rank():
+    cv, low = OrderedCondition(30, "cv"), OrderedLock(10, "low")
+    ran = []
+
+    def pred():
+        # during wait_for the cv rank is popped, so a LOWER-ranked lock
+        # is acquirable from the predicate without a violation
+        with low:
+            ran.append(1)
+        return True
+
+    with cv:
+        assert cv.wait_for(pred, timeout=1.0)
+        cv.notify_all()
+    assert ran == [1]
+    with cv:  # rank restored after the wait: low now violates again
+        with pytest.raises(LockOrderViolation):
+            with low:
+                pass
+
+
+def test_instrument_install_uninstall():
+    from deeplearning4j_tpu.parallel.resilience import CircuitBreaker
+    instrument.install()
+    instrument.install()  # idempotent
+    try:
+        cb = CircuitBreaker()
+        assert isinstance(cb._lock, OrderedLock)
+        with cb._lock:
+            pass
+    finally:
+        instrument.uninstall()
+    cb2 = CircuitBreaker()
+    assert not isinstance(cb2._lock, OrderedLock)

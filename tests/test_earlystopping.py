@@ -108,6 +108,24 @@ class TestEarlyStopping:
             TerminationReason.ITERATION_TERMINATION_CONDITION
         assert "MaxTime" in result.termination_details
 
+    def test_max_time_ignores_wall_clock_jump(self, monkeypatch):
+        """NTP step / VM migration regression: the time budget is
+        measured on the monotonic clock, so a wall-clock jump must not
+        fire termination early."""
+        import time as _time
+
+        cond = MaxTimeIterationTerminationCondition(3600.0)
+        cond.initialize()
+        real_time = _time.time
+        # wall clock steps 2h forward — budget is 1h, but ~0 monotonic
+        # seconds have elapsed
+        monkeypatch.setattr(_time, "time", lambda: real_time() + 7200.0)
+        assert not cond.terminate(0.0)
+        # a genuinely exhausted budget still fires
+        tiny = MaxTimeIterationTerminationCondition(0.0)
+        tiny.initialize()
+        assert tiny.terminate(0.0)
+
     def test_local_file_saver_roundtrip(self, tmp_path):
         net = _net()
         it = _iris_like_iterator()

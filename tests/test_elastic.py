@@ -396,9 +396,21 @@ class TestFailureDetection:
         # a worker whose file is garbage (half-written at crash)
         (hb_dir / "w2.heartbeat").write_text("{\"pid\": 3")
         try:
-            det = FailureDetector(str(hb_dir), timeout=10.0)
+            det = FailureDetector(str(hb_dir), timeout=1.0)
             assert set(det.workers()) == {"w0", "w1", "w2"}
-            assert det.dead_workers() == ["w1", "w2"]
+            # unreadable file is dead immediately; stale-but-readable ts
+            # needs a change-detection window (two scans) before it ages
+            # out on the observer's monotonic clock
+            assert det.dead_workers() == ["w2"]
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                dead = det.dead_workers()
+                if dead == ["w1", "w2"]:
+                    break
+                time.sleep(0.05)
+            # w1's ts never advances -> ages out; w0 keeps beating every
+            # 0.2s so its ts keeps changing and it stays alive
+            assert dead == ["w1", "w2"]
         finally:
             alive.stop()
 
@@ -451,7 +463,38 @@ class TestFailureDetection:
         hb.beat()  # one beat, then the "worker" wedges (no thread running)
         det = FailureDetector(str(tmp_path), timeout=5.0)
         assert det.dead_workers() == []
-        assert det.dead_workers(now=time.time() + 30) == ["w"]
+        # the persisted ts never advances; 30 observer-monotonic seconds
+        # later the worker has aged out
+        assert det.dead_workers(now=time.monotonic() + 30) == ["w"]
+
+    def test_wall_clock_jump_does_not_expire_fresh_lease(self, tmp_path,
+                                                         monkeypatch):
+        """NTP step / VM migration regression: the persisted heartbeat ts
+        is a VERSION NUMBER, so a wall-clock jump on either side must not
+        kill a freshly-beating worker. (The old scheme compared writer
+        wall clock to observer wall clock and declared every worker dead
+        the moment either clock stepped.)"""
+        hb = Heartbeat(str(tmp_path / "w.heartbeat"), interval=60)
+        hb.beat()
+        det = FailureDetector(str(tmp_path), timeout=5.0)
+        assert det.dead_workers() == []
+
+        # observer's wall clock jumps 2h forward: ts now looks 2h stale
+        # by wall math, but no observer-monotonic time has passed
+        real_time = time.time
+        monkeypatch.setattr(time, "time", lambda: real_time() + 7200.0)
+        assert det.dead_workers() == []
+
+        # writer's wall clock jumps too: the rewritten ts CHANGES, which
+        # only proves liveness — still not dead
+        hb.beat()
+        assert det.dead_workers() == []
+
+        # backward step on the writer (ts goes 2h into the past) is still
+        # just a new version — alive
+        monkeypatch.setattr(time, "time", lambda: real_time() - 7200.0)
+        hb.beat()
+        assert det.dead_workers() == []
 
 
 class TestDerivedResume:

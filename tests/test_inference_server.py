@@ -203,3 +203,43 @@ class TestBucketedCache:
             np.testing.assert_allclose(inf.output(x[:n]), full[:n],
                                        rtol=1e-5, atol=1e-6)
         assert len(inf._fwd_cache) <= 2
+
+
+@pytest.mark.serving
+class TestLockDiscipline:
+    """Targeted regressions for the graftcheck serving-lock fixes: the
+    draining flag is checked under self._lock in submit(), and the
+    dispatch counter is published under self._stats_lock."""
+
+    def test_submit_rejected_while_draining(self):
+        inf = ParallelInference(_mln(), workers=8)
+        inf.submit(_features(1)).result(timeout=30)
+        assert inf.drain(timeout=30)  # nothing pending -> completes
+        with pytest.raises(RuntimeError, match="draining"):
+            inf.submit(_features(1))
+        inf.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            inf.submit(_features(1))
+
+    def test_stats_dispatches_consistent_under_concurrent_readers(self):
+        snapshots = []
+        stop = threading.Event()
+        with ParallelInference(_mln(), workers=8, max_wait_ms=5) as inf:
+
+            def reader():
+                while not stop.is_set():
+                    snapshots.append(inf.stats()["dispatches"])
+
+            r = threading.Thread(target=reader, daemon=True)
+            r.start()
+            futs = [inf.submit(_features(1, seed=i)) for i in range(24)]
+            for f in futs:
+                f.result(timeout=60)
+            stop.set()
+            r.join(10)
+            final = inf.stats()
+        assert final["completed"] == 24
+        assert final["dispatches"] >= 1
+        # the counter only increments; a torn/unlocked read would show up
+        # as a non-monotone snapshot sequence
+        assert snapshots == sorted(snapshots)

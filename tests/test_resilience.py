@@ -667,3 +667,51 @@ class TestHttpResilienceMapping:
                                                   "features": [[3.0]]})
             assert status == 200 and body["output"] == [[6.0]]
         assert srv.stats()["retried"] >= 1
+
+
+# ------------------------------------------------- stats-lock discipline
+class TestServerStatsLockDiscipline:
+    def test_concurrent_predicts_count_exactly(self):
+        """Every stats counter moves under self._stats_lock (graftcheck
+        conc-mixed-lock gate): hammer predict() from many threads while a
+        reader spins on stats(); the final completed count must be exact
+        and no intermediate snapshot may exceed it."""
+        from deeplearning4j_tpu.modelimport.server import KerasBackendServer
+
+        class _Net:
+            def output(self, x):
+                return np.asarray(x) * 2.0
+
+        srv = KerasBackendServer(max_pending=64)
+        srv._models["m0"] = _Net()
+
+        threads, per, errs = 8, 25, []
+        snapshots = []
+        stop = threading.Event()
+
+        def reader():
+            while not stop.is_set():
+                snapshots.append(srv.stats()["completed"])
+
+        def hammer():
+            try:
+                for _ in range(per):
+                    out = srv.predict("m0", [[1.0, 2.0]])
+                    assert out == [[2.0, 4.0]]
+            except Exception as e:  # pragma: no cover - failure detail
+                errs.append(e)
+
+        r = threading.Thread(target=reader, daemon=True)
+        r.start()
+        ts = [threading.Thread(target=hammer) for _ in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        stop.set()
+        r.join(10)
+        assert errs == []
+        st = srv.stats()
+        assert st["completed"] == threads * per
+        assert st["failed"] == 0
+        assert all(0 <= s <= threads * per for s in snapshots)
