@@ -591,16 +591,26 @@ def bench_serve_chaos(n_requests: int = 256, max_batch: int = 64,
     }
 
 
-def bench_generate_serve(n_requests: int = 16, slots: int = 16,
+def bench_generate_serve(n_requests: int = 64, slots: int = 64,
                          vocab: int = 256, d_model: int = 256,
-                         n_blocks: int = 3):
-    """Continuous-batching generation throughput: ``n_requests``
+                         n_blocks: int = 3, repeats: int = 3):
+    """Paged continuous-batching generation throughput: ``n_requests``
     concurrent mixed-length greedy requests through ``GenerationServer``
-    (one slot-pooled decode step advances every active sequence) vs the
+    (page-pool KV-cache, batched wave prefill, ``steps_per_dispatch``
+    write-clamped decode micro-steps fused per host round trip) vs the
     SAME requests decoded serially via ``sample_generate`` (one fused
     scan per request — the pre-continuous-batching serving story).
+
+    64 slots, not 16: serial batch-1 decode is weight-bandwidth-bound
+    while batched decode is compute-bound, so the speedup keeps growing
+    with batch until the GEMMs saturate the core — 16 slots structurally
+    caps near 3.5x on one core, 64 clears 4x with margin. Serial and
+    server timed passes are INTERLEAVED ``repeats`` times and each side
+    takes its best pass, so a background load spike cannot deflate one
+    side of the ratio alone (this box is shared and noisy).
+
     Reports aggregate generated tokens/s for both paths, p50/p99 request
-    latency under the server, and the speedup, asserted >= 2x. Every
+    latency under the server, and the speedup, asserted >= 4x. Every
     server completion is checked BIT-identical to its serial greedy
     reference — zero lost or incorrect completions is part of the
     contract, not a separate test."""
@@ -628,14 +638,14 @@ def bench_generate_serve(n_requests: int = 16, slots: int = 16,
     # warmed first, so the comparison is steady-state vs steady-state
     for prompt, steps in reqs[:4]:
         sample_generate(net, prompt[None], steps, vocab, temperature=0.0)
-    t0 = time.perf_counter()
     refs = [sample_generate(net, prompt[None], steps, vocab,
                             temperature=0.0)[0] for prompt, steps in reqs]
-    serial_s = time.perf_counter() - t0
 
-    srv = GenerationServer(net, vocab, slots=slots)
+    srv = GenerationServer(net, vocab, slots=slots, steps_per_dispatch=16,
+                           max_pending=max(64, n_requests))
+    serial_s = server_s = float("inf")
     try:
-        # warm the decode step and both prefill buckets (8 and 16)
+        # warm the decode step and the prefill bucket
         for f in [srv.submit(p, 2) for p, _ in reqs[:2]]:
             f.result(timeout=SUB_BENCH_TIMEOUT_S)
         done_at = [None] * n_requests
@@ -646,29 +656,38 @@ def bench_generate_serve(n_requests: int = 16, slots: int = 16,
                 done_at[i] = time.perf_counter()
             return cb
 
-        t0 = time.perf_counter()
-        futs = []
-        for i, (prompt, steps) in enumerate(reqs):
-            t_submit[i] = time.perf_counter()
-            f = srv.submit(prompt, steps)
-            f.add_done_callback(make_cb(i))
-            futs.append(f)
-        outs = [f.result(timeout=SUB_BENCH_TIMEOUT_S) for f in futs]
-        server_s = time.perf_counter() - t0
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            for prompt, steps in reqs:
+                sample_generate(net, prompt[None], steps, vocab,
+                                temperature=0.0)
+            serial_s = min(serial_s, time.perf_counter() - t0)
+
+            t0 = time.perf_counter()
+            futs = []
+            for i, (prompt, steps) in enumerate(reqs):
+                t_submit[i] = time.perf_counter()
+                f = srv.submit(prompt, steps)
+                f.add_done_callback(make_cb(i))
+                futs.append(f)
+            outs = [f.result(timeout=SUB_BENCH_TIMEOUT_S) for f in futs]
+            server_s = min(server_s, time.perf_counter() - t0)
+
+            bad = sum(1 for got, ref in zip(outs, refs)
+                      if not np.array_equal(got, ref))
+            if bad:  # the zero-loss/zero-drift contract is the point
+                raise RuntimeError(
+                    f"{bad}/{n_requests} continuous-batched completions "
+                    "differ from their serial greedy references")
     finally:
         srv.close()
 
-    bad = sum(1 for got, ref in zip(outs, refs)
-              if not np.array_equal(got, ref))
-    if bad:  # the zero-loss/zero-drift contract is the point
-        raise RuntimeError(f"{bad}/{n_requests} continuous-batched "
-                           "completions differ from their serial greedy "
-                           "references")
     speedup = serial_s / server_s
-    if speedup < 2.0:
+    if speedup < 4.0:
         raise RuntimeError(
-            f"continuous batching {speedup:.2f}x serial decode — below "
-            "the 2x bar the slot pool exists to clear")
+            f"paged continuous batching {speedup:.2f}x serial decode — "
+            "below the 4x bar the page pool + fused decode dispatch "
+            "exist to clear")
     lat_ms = sorted((d - s) * 1e3 for d, s in zip(done_at, t_submit))
     return {
         "generate_serve_tokens_s": _sane("generate_serve_tokens_s",
@@ -678,6 +697,93 @@ def bench_generate_serve(n_requests: int = 16, slots: int = 16,
         "generate_serve_speedup": speedup,
         "generate_serve_p50_ms": lat_ms[len(lat_ms) // 2],
         "generate_serve_p99_ms": lat_ms[int(len(lat_ms) * 0.99)],
+    }
+
+
+def bench_generate_longtail(slots: int = 8, vocab: int = 256,
+                            d_model: int = 128, n_blocks: int = 2):
+    """Long-tail paged-serving memory: 16 requests with 16..2048-token
+    prompts sharing a 128-token system prefix, decoded under an explicit
+    page budget a contiguous ``[slots, max_len]`` KV-cache provably
+    cannot fit (the assertion, not a vibe: pool bytes < contiguous
+    bytes). Long prompts prefill through bounded Sarathi-style chunks,
+    short ones ride the shared-prefix page cache (COW), and the whole
+    workload is run TWICE on one server — the second pass rides fully
+    cached prefixes and must produce byte-identical completions, so
+    sharing/eviction can only save memory, never change output.
+
+    Reports server tokens/s, the resident-KV compression vs contiguous,
+    and prefix reuse counters."""
+    from deeplearning4j_tpu.models import TransformerLM
+    from deeplearning4j_tpu.parallel.generation import GenerationServer
+
+    page_size = 16
+    max_cache = 2176          # fits prompt 2048 + 16 generated, paged
+    max_tokens = 16
+    pages = 360               # vs slots * (max_cache/page_size) = 1088
+    plens = [16, 32, 64, 128, 256, 512, 1024, 2048]
+    net = TransformerLM(num_labels=vocab, max_length=max_cache,
+                        d_model=d_model, n_heads=4, n_blocks=n_blocks,
+                        seed=0).init()
+    for v in net.conf.vertices.values():
+        lyr = getattr(v, "layer", None)
+        if lyr is not None and hasattr(lyr, "max_cache"):
+            lyr.max_cache = max_cache
+    rs = np.random.RandomState(11)
+    system = rs.randint(0, vocab, 128)
+    prompts = []
+    for _rep in range(2):
+        for plen in plens:
+            if plen <= 128:
+                prompts.append(system[:plen])
+            else:
+                prompts.append(np.concatenate(
+                    [system, rs.randint(0, vocab, plen - 128)]))
+    n_requests = len(prompts)
+    n_tokens = n_requests * max_tokens
+
+    srv = GenerationServer(net, vocab, slots=slots, page_size=page_size,
+                           pages=pages, steps_per_dispatch=8,
+                           max_pending=2 * n_requests)
+    try:
+        contiguous_bytes = slots * max_cache * srv._page_token_bytes
+        pool_bytes = pages * page_size * srv._page_token_bytes
+        assert pool_bytes < contiguous_bytes, (
+            "longtail bench misconfigured: the page pool must be "
+            "smaller than the contiguous design it replaces")
+        # warm pass: compiles every chunk bucket + decode, and registers
+        # the shared prefix pages
+        warm = [f.result(timeout=SUB_BENCH_TIMEOUT_S)
+                for f in [srv.submit(p, max_tokens) for p in prompts]]
+        t0 = time.perf_counter()
+        futs = [srv.submit(p, max_tokens) for p in prompts]
+        outs = [f.result(timeout=SUB_BENCH_TIMEOUT_S) for f in futs]
+        server_s = time.perf_counter() - t0
+        st = srv.stats()
+    finally:
+        srv.close()
+
+    bad = sum(1 for got, ref in zip(outs, warm)
+              if not np.array_equal(got, ref))
+    if bad:  # prefix sharing / COW / eviction must never change output
+        raise RuntimeError(
+            f"{bad}/{n_requests} paged completions differ between the "
+            "cold and prefix-cached passes")
+    if st["pages"]["prefix_hits"] < n_requests:
+        raise RuntimeError(
+            f"only {st['pages']['prefix_hits']} prefix-cache hits across "
+            f"{2 * n_requests} admissions — the shared 128-token system "
+            "prefix should hit on every warm re-admission")
+    return {
+        "generate_longtail_tokens_s": _sane("generate_longtail_tokens_s",
+                                            n_tokens / server_s),
+        "generate_longtail_kv_compression": contiguous_bytes / pool_bytes,
+        "generate_longtail_prefix_hits": float(
+            st["pages"]["prefix_hits"]),
+        "generate_longtail_prefix_tokens_reused": float(
+            st["pages"]["prefix_tokens_reused"]),
+        "generate_longtail_cow_copies": float(
+            st["pages"]["cow_copies"]),
     }
 
 
@@ -797,6 +903,7 @@ SANITY_CEILING = {
     "serve_chaos_req_s": 1e8,
     "generate_serve_tokens_s": 1e9,
     "generate_serve_serial_tokens_s": 1e9,
+    "generate_longtail_tokens_s": 1e9,
     "vgg16_bf16_img_s": 1e5,
     "textgen_lstm_tokens_s": 1e9,
     "transformer_lm_tokens_s": 1e9,
@@ -844,6 +951,11 @@ METRIC_UNIT = {
     "generate_serve_speedup": "x",
     "generate_serve_p50_ms": "ms",
     "generate_serve_p99_ms": "ms",
+    "generate_longtail_tokens_s": "tokens/s",
+    "generate_longtail_kv_compression": "x",
+    "generate_longtail_prefix_hits": "hits",
+    "generate_longtail_prefix_tokens_reused": "tokens",
+    "generate_longtail_cow_copies": "copies",
     "vgg16_bf16_img_s": "img/s",
     "textgen_lstm_tokens_s": "tokens/s",
     "transformer_lm_tokens_s": "tokens/s",
@@ -1072,7 +1184,7 @@ def main():
     valid = ("all", "resnet50", "vgg16", "lenet", "lstm", "transformer",
              "word2vec", "doc2vec", "attention", "fit_e2e", "eval_e2e",
              "guard_overhead", "inference_serve", "serve_chaos",
-             "generate_serve")
+             "generate_serve", "generate_longtail")
     if which not in valid:
         sys.exit(f"Unknown model '{which}'; choose one of {valid}")
     # persistent XLA compile cache: repeated bench runs skip the
@@ -1125,6 +1237,8 @@ def main():
         headline and headline.sample("post-serve-chaos")
     if which in ("all", "generate_serve"):
         _sub_metric(extras, "generate_serve", bench_generate_serve)
+    if which in ("all", "generate_longtail"):
+        _sub_metric(extras, "generate_longtail", bench_generate_longtail)
         headline and headline.sample("post-generate-serve")
     if which in ("all", "vgg16"):
         _sub_metric(extras, "vgg16_bf16_img_s", bench_vgg16, digits=2)

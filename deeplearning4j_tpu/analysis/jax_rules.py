@@ -43,7 +43,8 @@ SAFE_CALLS = {"isinstance", "len", "hasattr", "callable", "type", "getattr"}
 # functions that ARE the serving/training hot loops; one host sync here
 # stalls every slot/request in the batch
 HOT_FUNCTIONS = {
-    "_decode_once", "_prefill_into",              # generation slot loop
+    "_decode_once", "_prefill_wave",              # generation slot loop
+    "_spec_decode_once",                          # speculative verify loop
     "_coalesce_loop", "_complete_loop",           # inference coalescer
     "_dispatch_batch", "_dispatch_fwd",           # inference dispatch
     "_run_block", "fit_stream",                   # fused-fit driver loop

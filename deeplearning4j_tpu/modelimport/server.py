@@ -223,12 +223,18 @@ class KerasBackendServer:
     def attach_generation(self, net, *, vocab: int, slots: int = 4,
                           eos_id: Optional[int] = None,
                           mid: Optional[str] = None, **gen_kw) -> str:
-        """Register a causal LM for /generate, served by a slot-pooled
-        ``GenerationServer`` (continuous batching — parallel/generation.py).
-        ``net`` may be a model instance or an already-imported model id;
-        returns the model id /generate requests should name. Extra kwargs
-        (max_pending, request_deadline_s, retry, breaker, chaos, ...) are
-        forwarded to the ``GenerationServer``."""
+        """Register a causal LM for /generate, served by a paged
+        ``GenerationServer`` (continuous batching over a page-pool
+        KV-cache — parallel/generation.py). ``net`` may be a model
+        instance or an already-imported model id; returns the model id
+        /generate requests should name. Extra kwargs are forwarded to
+        the ``GenerationServer``: paging (``page_size``, ``pages``,
+        ``prefix_cache``, ``prefill_chunk``, ``steps_per_dispatch``),
+        speculative decoding (``draft_net``, ``spec_k``), and
+        resilience (max_pending, request_deadline_s, retry, breaker,
+        chaos, ...). Page-pool occupancy, prefix-cache reuse, COW, and
+        speculation counters surface per model under ``pages`` in
+        /stats."""
         from deeplearning4j_tpu.parallel.generation import GenerationServer
 
         with self._lock:

@@ -886,6 +886,33 @@ def sampled_next_token(probs, keys, temperature, top_k):
     return jnp.where(temperature <= 0, greedy, sampled)
 
 
+def spec_verify_tokens(probs, base_keys, counts, temperature, top_k):
+    """Target-model token selection at K consecutive positions per row —
+    the verification half of speculative decoding.
+
+    probs: [B, K, V] softmax outputs of one chunked forward over
+    [last_token, draft_1, ..., draft_{K-1}]; base_keys: [B, 2] uint32;
+    counts: [B] index of the FIRST token being selected; temperature /
+    top_k: [B] traced per-row values. Position i of row b selects with
+    ``fold_in(base_keys[b], counts[b] + i)`` — the SAME key schedule the
+    serial decode uses for that token index, which is what makes
+    speculative acceptance bit-exact: every emitted token is literally
+    the target model's selection under the serial schedule, regardless
+    of what the draft proposed."""
+    import jax
+    import jax.numpy as jnp
+
+    B, K, V = probs.shape
+    idx = counts[:, None] + jnp.arange(K, dtype=counts.dtype)   # [B, K]
+    keys = jax.vmap(jax.vmap(jax.random.fold_in, (None, 0)),
+                    (0, 0))(base_keys, idx)                     # [B, K, 2]
+    flat = sampled_next_token(probs.reshape(B * K, V),
+                              keys.reshape(B * K, 2),
+                              jnp.repeat(temperature, K),
+                              jnp.repeat(top_k, K))
+    return flat.reshape(B, K)
+
+
 def greedy_generate(net, prompt_ids, steps: int, vocab: int,
                     device_loop: bool = True):
     """Greedy decoding — ``sample_generate`` with temperature 0 (see

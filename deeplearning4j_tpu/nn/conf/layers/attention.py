@@ -114,6 +114,8 @@ class SelfAttentionLayer(BaseLayer):
         return scaled_dot_attention(q, k, v, causal=self.causal, mask=mask)
 
     def forward(self, params, state, x, *, mask=None, train=False, rng=None):
+        if "kpages" in state:
+            return self._paged_forward(params, state, x, mask=mask)
         if "kcache" in state:
             return self._streaming_forward(params, state, x, mask=mask)
         x = self.apply_input_dropout(x, train=train, rng=rng)
@@ -129,6 +131,27 @@ class SelfAttentionLayer(BaseLayer):
         return self.act()(out), state
 
     # ------------------------------------------------- streaming decode
+    def init_paged_carry(self, pages: int, page_size: int,
+                         dtype=jnp.float32) -> dict:
+        """KV cache as a POOL of fixed-size pages (vLLM-style) instead of
+        one contiguous [B, max_cache] strip per stream. The pool is shared
+        by every slot of a serving batch: a ``[B, n_pages]`` block table
+        (passed per call in ``state``) maps each row to its page list, so
+        HBM cost is proportional to tokens actually resident — and two
+        rows whose block tables name the same page share it (copy-on-write
+        is the CALLER's job: this layer never checks refcounts, it just
+        reads/writes where the table points). Only causal layers stream;
+        non-causal layers return no carry (same rule as
+        ``init_streaming_carry``)."""
+        if not self.causal:
+            return {}
+        H = self.n_heads
+        d = self.n_out // H
+        return {
+            "kpages": jnp.zeros((pages, H, page_size, d), dtype),
+            "vpages": jnp.zeros((pages, H, page_size, d), dtype),
+        }
+
     def init_streaming_carry(self, batch: int, dtype=jnp.float32) -> dict:
         """KV cache for incremental decode (the transformer analog of the
         LSTM's h/c streaming state behind rnnTimeStep): keys/values of
@@ -188,15 +211,18 @@ class SelfAttentionLayer(BaseLayer):
         k = self._split_heads(jnp.einsum("btf,fo->bto", x, params["Wk"]))
         v = self._split_heads(jnp.einsum("btf,fo->bto", x, params["Wv"]))
         if per_row:
-            # scatter each row's chunk at its own offset: advanced indices
-            # [B,1] x [B,T] straddle the head slice, so the updated value
-            # carries [B,T,H,d] layout
-            bidx = jnp.arange(B)[:, None]
-            t_idx = pos[:, None] + jnp.arange(T)[None, :]
-            kc = kc.at[bidx, :, t_idx, :].set(
-                k.astype(kc.dtype).transpose(0, 2, 1, 3))
-            vc = vc.at[bidx, :, t_idx, :].set(
-                v.astype(vc.dtype).transpose(0, 2, 1, 3))
+            # write each row's chunk at its own offset as a vmapped
+            # dynamic-update-slice: unlike an advanced-index scatter
+            # (which XLA CPU lowers to an element loop) this aliases
+            # in-place inside donated decode scans — the slot-pooled
+            # decode step pays this write 2x per layer per token
+            z = jnp.zeros((), pos.dtype)
+            kc = jax.vmap(
+                lambda c, u, p: jax.lax.dynamic_update_slice(
+                    c, u, (z, p, z)))(kc, k.astype(kc.dtype), pos)
+            vc = jax.vmap(
+                lambda c, u, p: jax.lax.dynamic_update_slice(
+                    c, u, (z, p, z)))(vc, v.astype(vc.dtype), pos)
         else:
             z = jnp.zeros((), jnp.int32)  # index dtypes must all match pos's
             kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
@@ -229,6 +255,94 @@ class SelfAttentionLayer(BaseLayer):
         new_state = dict(state)
         new_state["kcache"] = kc
         new_state["vcache"] = vc
+        new_state["cache_pos"] = pos + T
+        return self.act()(out), new_state
+
+    def _paged_forward(self, params, state, x, mask=None):
+        """Incremental decode over a paged KV pool (see init_paged_carry).
+
+        ``state`` carries, besides the pool itself:
+          - ``block_table``: ``[B, n_pages]`` int32, row b's i-th logical
+            page lives in pool page ``block_table[b, i]``. Rows may share
+            pages (prefix sharing); the caller guarantees copy-on-write,
+            i.e. a page a row WRITES into this call is owned by that row
+            alone (or is a designated garbage page).
+          - ``cache_pos``: ``[B]`` per-row stream positions, exactly as in
+            the per-row ``_streaming_forward`` path.
+
+        The attention math is the dense per-row path verbatim over the
+        gathered ``[B, H, n_pages*page_size, d]`` view, so outputs are
+        bit-identical to a contiguous cache of capacity
+        ``n_pages * page_size`` holding the same tokens.
+        """
+        B, T, _ = x.shape
+        kp, vp = state["kpages"], state["vpages"]
+        bt = state["block_table"]
+        pos = state["cache_pos"]
+        if getattr(pos, "ndim", 0) != 1:
+            raise ValueError("paged attention requires per-row [B] "
+                             f"cache_pos, got shape {getattr(pos, 'shape', ())}")
+        ps = kp.shape[2]
+        NP = bt.shape[1]
+        Tmax = NP * ps
+        if not isinstance(pos, jax.core.Tracer):
+            hi = int(jnp.max(pos))
+            if hi + T > Tmax:
+                raise ValueError(
+                    f"paged KV overflow: position {hi} + {T} new tokens > "
+                    f"block table capacity {NP} pages x {ps} = {Tmax}")
+        if mask is not None:
+            mask = jnp.asarray(mask)
+            if mask.shape != (B, T):
+                raise ValueError(
+                    f"streaming attention mask must be [batch, chunk] = "
+                    f"({B}, {T}), got {mask.shape}; per-feature or "
+                    "flattened masks cannot be applied to the KV cache")
+        q = self._split_heads(jnp.einsum("btf,fo->bto", x, params["Wq"]))
+        k = self._split_heads(jnp.einsum("btf,fo->bto", x, params["Wk"]))
+        v = self._split_heads(jnp.einsum("btf,fo->bto", x, params["Wv"]))
+        # scatter the chunk at per-row offsets, routed through the block
+        # table: logical position p of row b lands in pool page
+        # bt[b, p // ps] at offset p % ps. Advanced indices [B,T] straddle
+        # the head slice, so the updated value carries [B,T,H,d] layout.
+        t_abs = pos[:, None] + jnp.arange(T)[None, :]            # [B,T]
+        pg = jnp.take_along_axis(bt, jnp.minimum(t_abs // ps, NP - 1),
+                                 axis=1)                         # [B,T]
+        off = t_abs % ps
+        if mask is not None:
+            # masked (right-padding) columns write pool page 0 — the
+            # caller-reserved garbage sink — so padded prefill chunks
+            # never dirty real pages and a row needs page backing for
+            # its true tokens only
+            pg = jnp.where(mask.astype(bool), pg, 0)
+        kp = kp.at[pg, :, off, :].set(k.astype(kp.dtype).transpose(0, 2, 1, 3))
+        vp = vp.at[pg, :, off, :].set(v.astype(vp.dtype).transpose(0, 2, 1, 3))
+        # gather each row's logical cache view: [B,NP,H,ps,d] -> [B,H,Tmax,d]
+        kc = kp[bt].transpose(0, 2, 1, 3, 4).reshape(B, -1, Tmax, kp.shape[-1])
+        vc = vp[bt].transpose(0, 2, 1, 3, 4).reshape(B, -1, Tmax, vp.shape[-1])
+        d = q.shape[-1]
+        logits = jnp.einsum("bhtd,bhkd->bhtk", q, kc) / jnp.sqrt(
+            jnp.asarray(d, q.dtype))
+        col = jnp.arange(Tmax)[None, None, None, :]
+        row = jnp.arange(T)[None, None, :, None]
+        logits = jnp.where(col <= pos.reshape(-1, 1, 1, 1) + row,
+                           logits, NEG_INF)
+        if mask is not None:
+            colv = jnp.arange(Tmax)[None, :]
+            rel = colv - pos[:, None]                            # [B,Tmax]
+            chunk_valid = jnp.take_along_axis(
+                mask.astype(bool), jnp.clip(rel, 0, T - 1), axis=1)
+            key_valid = jnp.where((rel >= 0) & (rel < T), chunk_valid, True)
+            logits = jnp.where(key_valid[:, None, None, :], logits, NEG_INF)
+        o = jnp.einsum("bhtk,bhkd->bhtd",
+                       jax.nn.softmax(logits, axis=-1), vc)
+        o = o.transpose(0, 2, 1, 3).reshape(B, T, self.n_out)
+        out = jnp.einsum("bto,op->btp", o, params["Wo"]) + params["b"]
+        if mask is not None:
+            out = out * mask.astype(out.dtype)[:, :, None]
+        new_state = dict(state)
+        new_state["kpages"] = kp
+        new_state["vpages"] = vp
         new_state["cache_pos"] = pos + T
         return self.act()(out), new_state
 

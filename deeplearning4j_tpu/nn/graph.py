@@ -502,20 +502,27 @@ class ComputationGraph:
         self._stream_pos = 0
         self._stream_capacity = None
 
+    def _stream_layers(self):
+        """(name, layer) pairs keyed exactly as the streaming carry dict —
+        the shared vocabulary between ``_seed_streaming_carry`` and
+        carry-restructuring callers (GenerationServer's paged pool)."""
+        for name, v in self.conf.vertices.items():
+            layer = getattr(v, "layer", None)
+            if layer is not None and hasattr(layer, "init_streaming_carry"):
+                yield name, layer
+
     def _seed_streaming_carry(self, batch: int) -> dict:
         """Initial streaming carry (attention KV caches / positional
         counters) + side effects: resets the static overflow accounting."""
         dtype = jnp.dtype(self.conf.dtype)
         seed = {}
         caps = []
-        for name, v in self.conf.vertices.items():
-            layer = getattr(v, "layer", None)
-            if layer is not None and hasattr(layer, "init_streaming_carry"):
-                c = layer.init_streaming_carry(batch, dtype)
-                if c:
-                    seed[name] = c
-                    if hasattr(layer, "max_cache"):
-                        caps.append(layer.max_cache)
+        for name, layer in self._stream_layers():
+            c = layer.init_streaming_carry(batch, dtype)
+            if c:
+                seed[name] = c
+                if hasattr(layer, "max_cache"):
+                    caps.append(layer.max_cache)
         self._stream_pos = 0
         self._stream_capacity = min(caps) if caps else None
         return seed

@@ -34,7 +34,8 @@ from deeplearning4j_tpu.optimize.bucketing import (BoundedCache, bucket_rows,
                                                    pad_rows)
 from deeplearning4j_tpu.utils.pytree import flatten_params, unflatten_params
 
-_RNN_KEYS = ("h", "c", "kcache", "vcache", "cache_pos")
+_RNN_KEYS = ("h", "c", "kcache", "vcache", "cache_pos",
+             "kpages", "vpages", "block_table")
 
 
 def _split_state(state):
@@ -43,7 +44,9 @@ def _split_state(state):
     h/c: recurrent hidden state (LSTM family). kcache/vcache/cache_pos:
     attention KV-cache streaming state (SelfAttentionLayer /
     PositionalEncodingLayer incremental decode) — present only when a
-    streaming carry was seeded by rnn_time_step, never during training."""
+    streaming carry was seeded by rnn_time_step, never during training.
+    kpages/vpages/block_table: the paged-pool variant of the same carry
+    (GenerationServer's block-table serving path)."""
     persistent, carry = {}, {}
     for k, v in state.items():
         (carry if k in _RNN_KEYS else persistent)[k] = v
@@ -526,15 +529,22 @@ class MultiLayerNetwork:
         self._rnn_state = new_carry
         return out[:, 0] if squeeze and out.ndim == 3 else out
 
+    def _stream_layers(self):
+        """(name, layer) pairs keyed exactly as the streaming carry dict —
+        the shared vocabulary between ``_seed_streaming_carry`` and
+        carry-restructuring callers (GenerationServer's paged pool)."""
+        for i, layer in enumerate(self.layers):
+            yield str(i), layer
+
     def _seed_streaming_carry(self, batch: int) -> dict:
         """Initial streaming carry + resets static overflow accounting."""
         dtype = jnp.dtype(self.conf.dtype)
         seed = {}
         caps = []
-        for i, layer in enumerate(self.layers):
+        for name, layer in self._stream_layers():
             c = layer.init_streaming_carry(batch, dtype)
             if c:
-                seed[str(i)] = c
+                seed[name] = c
                 if hasattr(layer, "max_cache"):
                     caps.append(layer.max_cache)
         self._stream_pos = 0

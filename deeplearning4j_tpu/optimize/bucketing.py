@@ -68,6 +68,30 @@ def bucket_length(n: int, minimum: int = 8,
     return b
 
 
+def bucket_pages(n: int, page_size: int,
+                 maximum: "int | None" = None) -> int:
+    """Number of fixed-size KV pages covering ``n`` tokens, rounded up to
+    a power of two so paged-prefill programs compile per PAGE bucket
+    rather than per pow2 TOKEN bucket (an 810-token and a 900-token
+    prompt land on the same 64-page program when ``page_size=16``).
+    ``maximum`` caps the bucket at a block table's page count; unlike
+    ``bucket_length`` the cap is on pages, and ``n`` itself exceeding
+    ``maximum * page_size`` tokens is the caller's admission error."""
+    if page_size < 1:
+        raise ValueError(f"page_size must be >= 1, got {page_size}")
+    if n < 1:
+        raise ValueError(f"need at least one token, got {n}")
+    pages = bucket_rows(-(-int(n) // int(page_size)))
+    if maximum is not None:
+        if n > maximum * page_size:
+            raise ValueError(
+                f"sequence of {n} tokens exceeds the page budget "
+                f"{maximum} pages x {page_size}")
+        if pages > maximum:
+            pages = int(maximum)
+    return pages
+
+
 def pad_rows(a, target: int):
     """Pad ``a``'s leading dim up to ``target`` by replicating the last row
     (numpy in, numpy out; jax in, jax out — device arrays are padded on

@@ -1,66 +1,77 @@
-"""Continuous-batching generation serving: slot-pooled KV caches.
+"""Continuous-batching generation serving over a PAGED KV-cache pool.
 
 ``sample_generate`` compiles a whole decode into one program per request —
 great latency for ONE caller, but N concurrent callers run N programs
-back-to-back: a long request head-of-line-blocks everything behind it and
-every step does batch-1 matmuls. ``GenerationServer`` applies
-iteration-level (continuous) batching — Orca (Yu et al., OSDI '22) — over
-a fixed pool of S decode slots backed by ONE pre-allocated KV-cache pytree
-of shape ``[S, ...]`` (the dense-slot special case of vLLM's paged pool,
-Kwon et al., SOSP '23):
+back-to-back. ``GenerationServer`` applies iteration-level (continuous)
+batching — Orca (Yu et al., OSDI '22) — over a fixed pool of S decode
+slots, and stores every slot's KV cache in a shared pool of fixed-size
+PAGES behind a block table (vLLM, Kwon et al., SOSP '23):
 
-- ONE compiled decode step advances ALL active sequences per iteration.
-  Per-slot stream positions ride in the carry as a ``[S]`` vector (the
-  attention layer masks each row by its own true length), so empty or
-  finished slots compute masked-out garbage and occupancy changes NEVER
-  retrace — the step compiles exactly once.
-- New requests are admitted into free slots between steps by a compiled
-  prefill-into-slot program; prompt lengths are padded onto pow2 buckets
-  (``optimize/bucketing.bucket_length``) so prefill has a handful of
-  stable shapes. The prompt's padded tail is masked out of attention and
-  the slot's length watermark is set to the TRUE prompt length.
-- Finished sequences (EOS or max-tokens) retire their slot immediately
-  and resolve their ``Future`` — short requests are never held hostage
-  by long ones.
-- Sampling params (temperature / top_k / rng) are traced per-slot VALUES,
-  not static args, so a batch mixing greedy and sampled requests shares
-  the same program. Greedy rows take the same argmax op
-  ``_device_generate`` compiles, so greedy outputs are bit-identical to
-  ``greedy_generate``.
+- The device carry is ONE donated pytree of ``[pages, H, page_size, d]``
+  K/V pools per attention layer. A host-owned ``[S, max_pages]`` int32
+  block table maps each slot to its page list and rides into every
+  dispatch as DATA, so HBM cost is proportional to tokens actually
+  resident — not slots x worst-case length — and occupancy churn, page
+  churn, or sharing changes NEVER retrace. Page 0 is reserved as the
+  garbage page that inactive slots harmlessly write into.
+- PREFIX SHARING: prompts are hashed per page-aligned chunk with a
+  chained digest; a prompt whose leading chunks match pages already
+  resident shares them refcounted read-only and prefills only its
+  suffix. Shared (or prefix-cache-registered) pages are copy-on-write:
+  the first divergent write — including a request's own first decode
+  token landing in its registered tail page — copies the page off with
+  a tiny compiled page-copy program and repoints the block table.
+- One compiled decode program advances all active slots by
+  ``steps_per_dispatch`` micro-steps (a ``lax.scan``) per host round
+  trip, with ONE batched token fetch — the serial key schedule
+  (``fold_in(base_key, token_index)``) makes the result bit-identical
+  to ``greedy_generate``/``sample_generate`` token-for-token.
+- SPECULATIVE DECODING (``draft_net`` + ``spec_k``): a small draft model
+  with a dense slot cache proposes K-1 tokens per slot under the SAME
+  key schedule, and the target verifies all K positions in one chunked
+  paged dispatch. Emitted tokens are always the TARGET's selections
+  under the serial schedule, so outputs are bit-exact regardless of
+  draft quality — the draft only buys throughput (accept rate is
+  surfaced in ``stats()``).
+- Admission is PAGE accounting, not slot counting: ``submit()`` rejects
+  a request whose prompt + max_tokens (+ look-ahead margin) cannot fit
+  the page budget with a typed ``ServerOverloaded`` up front, and under
+  transient pressure the newest slot is preempted — its pages freed, the
+  request requeued at the front; the deterministic key schedule makes
+  the re-decode bit-identical, so preemption is invisible in outputs.
 
 The serving posture mirrors ``ParallelInference`` (parallel/resilience.py):
 ``submit(...) -> Future``, an ``AdmissionController`` watermark on the
-waiting queue (``ServerOverloaded`` past it), per-request deadlines checked
-between steps (``DeadlineExceeded`` — queued or mid-generation, the slot is
-freed either way), a circuit breaker over dispatch health, retries for
-transient faults, and a ``drain()``/``close()`` lifecycle that resolves
-every outstanding future.
-
-The pooled carry is donated back to each step on every backend (CPU
-included — XLA aliases host buffers too), so the cache updates in place:
-a decode step writes one column per slot instead of copying S full
-caches per iteration.
+waiting queue, per-request deadlines checked between steps, a circuit
+breaker over dispatch health, retries for transient faults, and a
+``drain()``/``close()`` lifecycle that resolves every outstanding future.
 """
 
 from __future__ import annotations
 
+import hashlib
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from concurrent.futures import Future
 from typing import Optional
 
 import numpy as np
 
-from deeplearning4j_tpu.optimize.bucketing import bucket_length
+from deeplearning4j_tpu.optimize.bucketing import bucket_length, bucket_pages
 from deeplearning4j_tpu.parallel.resilience import (AdmissionController,
                                                     ChaosPolicy,
                                                     CircuitBreaker,
                                                     CircuitOpen, Deadline,
                                                     DeadlineExceeded,
-                                                    RetryPolicy)
+                                                    RetryPolicy,
+                                                    ServerOverloaded)
 
 _UNSET = object()
+
+#: pool page 0 never backs real tokens: inactive slots' block-table rows
+#: are all zeros, so their masked garbage writes land here
+GARBAGE_PAGE = 0
 
 
 class _Request:
@@ -81,13 +92,108 @@ class _Request:
         self.t_submit = time.monotonic()
 
 
+class _PagePool:
+    """Host-side accounting for the device page pool: a free stack,
+    per-page refcounts, and an LRU prefix cache mapping chained content
+    digests to resident pages. Page 0 is the reserved garbage page.
+    Owned by the serving loop thread — like ``_slot_req``, never locked;
+    ``stats()`` reads are racy-but-atomic snapshots."""
+
+    def __init__(self, pages: int):
+        self.total = int(pages)
+        self.free = list(range(self.total - 1, 0, -1))  # pop() -> page 1
+        self.ref = [0] * self.total
+        self.cache: OrderedDict = OrderedDict()  # digest -> page (LRU)
+        self.tag: dict = {}                      # page -> digest
+        self.evictions = 0
+        self.peak = 0
+
+    def in_use(self) -> int:
+        """Pages holding live data: refcounted by a slot OR retained by
+        the prefix cache (reclaimable, but resident)."""
+        return self.total - 1 - len(self.free)
+
+    def alloc(self) -> Optional[int]:
+        """One page at refcount 1, evicting the oldest reclaimable
+        cached page when the free list is dry; None when exhausted."""
+        if not self.free:
+            for digest, page in list(self.cache.items()):  # oldest first
+                if self.ref[page] == 0:
+                    self._uncache(digest, page)
+                    self.evictions += 1
+                    break
+        if not self.free:
+            return None
+        page = self.free.pop()
+        self.ref[page] = 1
+        self.peak = max(self.peak, self.in_use())
+        return page
+
+    def _uncache(self, digest: bytes, page: int) -> None:
+        del self.cache[digest]
+        del self.tag[page]
+        if self.ref[page] == 0:
+            self.free.append(page)
+
+    def share(self, page: int) -> None:
+        self.ref[page] += 1
+
+    def release(self, page: int) -> None:
+        self.ref[page] -= 1
+        if self.ref[page] == 0 and page not in self.tag:
+            self.free.append(page)
+
+    def protected(self, page: int) -> bool:
+        """True when a write to ``page`` must copy first: another slot or
+        the prefix cache depends on its current content."""
+        return self.ref[page] > 1 or page in self.tag
+
+    def lookup(self, digest: bytes) -> Optional[int]:
+        page = self.cache.get(digest)
+        if page is not None:
+            self.cache.move_to_end(digest)
+        return page
+
+    def register(self, digest: bytes, page: int) -> None:
+        """Publish ``page`` for future prefix matches. No-op when the
+        digest is already cached (the pristine original wins — a COW
+        copy of it is about to diverge) or the page already tagged."""
+        if digest in self.cache or page in self.tag:
+            return
+        self.cache[digest] = page
+        self.tag[page] = digest
+
+    def shared_count(self) -> int:
+        return sum(1 for r in self.ref if r > 1)
+
+    def refcounted(self) -> int:
+        return sum(1 for r in self.ref if r > 0)
+
+
 class GenerationServer:
-    """Slot-pooled continuous-batching decode server for a causal LM.
+    """Paged continuous-batching decode server for a causal LM.
 
     ``net`` must stream through an explicit KV-cache carry (TransformerLM:
-    attention kcache/vcache + positional counters). ``submit`` returns a
-    ``concurrent.futures.Future`` resolving to the generated token ids
+    attention kcache/vcache + positional counters); the caches are
+    re-homed into a page pool (``init_paged_carry``). ``submit`` returns
+    a ``concurrent.futures.Future`` resolving to the generated token ids
     (numpy int array, EOS token included when hit).
+
+    Paging knobs: ``page_size`` tokens per KV page (must divide the
+    attention ``max_cache``); ``pages`` total pool pages (default
+    ``slots * max_cache/page_size + 1`` — dense-equivalent capacity; set
+    lower to serve long-tail workloads in less memory); ``prefix_cache``
+    toggles chunk-hash prefix sharing; ``steps_per_dispatch`` decode
+    micro-steps fused per host round trip; ``prefill_chunk`` caps the
+    tokens a prefill round consumes per row (Sarathi-style chunked
+    prefill — long prompts advance through several bounded dispatches
+    instead of one huge one, without changing any output bit).
+
+    Speculative decoding: pass a small ``draft_net`` (same vocab, its own
+    weights, ``max_cache >= `` the target's) and ``spec_k >= 2``; each
+    round the draft proposes ``spec_k - 1`` tokens and the target
+    verifies all ``spec_k`` positions in one chunked dispatch. Bit-exact
+    with the non-speculative paths by construction.
     """
 
     def __init__(self, net, vocab: int, *, slots: int = 8,
@@ -95,21 +201,73 @@ class GenerationServer:
                  max_pending: int = 64,
                  request_deadline_s: Optional[float] = None,
                  min_prefill_bucket: int = 8,
+                 prefill_chunk: int = 256,
+                 page_size: int = 16,
+                 pages: Optional[int] = None,
+                 prefix_cache: bool = True,
+                 steps_per_dispatch: int = 4,
+                 draft_net=None,
+                 spec_k: int = 4,
                  retry: Optional[RetryPolicy] = None,
                  breaker: Optional[CircuitBreaker] = None,
                  chaos: Optional[ChaosPolicy] = None):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if steps_per_dispatch < 1:
+            raise ValueError(f"steps_per_dispatch must be >= 1, got "
+                             f"{steps_per_dispatch}")
+        if prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, got "
+                             f"{prefill_chunk}")
         self.net = net
         self.vocab = int(vocab)
         self.slots = int(slots)
         self.eos_id = eos_id
         self.request_deadline_s = request_deadline_s
         self.min_prefill_bucket = int(min_prefill_bucket)
+        self.prefill_chunk = int(prefill_chunk)
+        self.prefix_cache = bool(prefix_cache)
+        self.steps_per_dispatch = int(steps_per_dispatch)
+        self.spec_k = int(spec_k)
         self.admission = AdmissionController(max_pending)
         self.retry = retry if retry is not None else RetryPolicy()
         self.breaker = breaker if breaker is not None else CircuitBreaker()
         self._chaos = chaos
+
+        self._ps = int(page_size)
+        # prefill rounds advance at most this many (page-aligned) tokens
+        # per dispatch, bounding the transient [S, chunk, ...] prefill
+        # activations regardless of prompt length
+        self._chunk_cap = max(self._ps,
+                              self.prefill_chunk // self._ps * self._ps)
+        self._probe_net()
+        if pages is None:
+            pages = self.slots * self._np + 1
+        self.pages_total = int(pages)
+        # the pool may be SMALLER than slots x full capacity (that is the
+        # point: HBM ∝ resident tokens) — submit() rejects any single
+        # request the budget cannot cover, and transient multi-slot
+        # pressure preempts the newest slot; only the garbage page plus
+        # one usable page are unconditionally required
+        if self.pages_total < 2:
+            raise ValueError(f"pages={self.pages_total} must be >= 2 "
+                             "(the reserved garbage page + one usable)")
+        self._page_bytes = self._page_token_bytes * self._ps
+
+        self._draft = draft_net
+        self._draft_cap = None
+        if draft_net is not None:
+            if self.spec_k < 2:
+                raise ValueError(f"spec_k must be >= 2 (one verified "
+                                 f"chunk needs at least one draft token), "
+                                 f"got {self.spec_k}")
+            self._probe_draft()
+        # decode-write look-ahead per dispatch: M fused micro-steps, or
+        # the K-token speculative chunk
+        self._lookahead = self.spec_k if draft_net is not None \
+            else self.steps_per_dispatch
 
         self._cond = threading.Condition()
         self._queue: deque = deque()
@@ -124,6 +282,14 @@ class GenerationServer:
         self._temp = np.zeros(self.slots, np.float32)
         self._topk = np.zeros(self.slots, np.int32)
         self._keys = np.zeros((self.slots, 2), np.uint32)
+        # host-owned paging state: per-slot positions, block table, and
+        # page lists (loop-thread-owned, like _slot_req)
+        self._pos = np.zeros(self.slots, np.int32)
+        self._bt = np.zeros((self.slots, self._np), np.int32)
+        self._slot_pages: list = [[] for _ in range(self.slots)]
+        self._slot_seq = [0] * self.slots
+        self._admit_seq = 0
+        self._page_pool = _PagePool(self.pages_total)
 
         self._admitted = 0
         self._expired = 0
@@ -135,53 +301,147 @@ class GenerationServer:
         self._decode_steps = 0
         self._tokens = 0
         self._busy_s = 0.0
+        self._cow_copies = 0
+        self._preempted = 0
+        self._prefix_hits = 0
+        self._prefix_tokens_reused = 0
+        self._spec_rounds = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
 
-        self._capacity = None
-        self._carry = self._fresh_pool()
-        if self._carry is None:
-            raise ValueError(
-                "net has no seedable streaming KV carry — GenerationServer "
-                "serves KV-cache streaming language models (TransformerLM)")
+        self._pool = self._fresh_pool()
+        self._dpool = None if draft_net is None else self._fresh_draft_pool()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="generation-server")
         self._thread.start()
 
+    # ------------------------------------------------------ introspection
+    def _probe_net(self):
+        """Classify the net's streaming layers for the paged carry: which
+        vertices hold pageable KV caches, which only carry positions —
+        and derive the block-table geometry from the KV capacity."""
+        net = self.net
+        net.rnn_clear_previous_state()
+        probe = net._seed_streaming_carry(1)
+        cap = net._stream_capacity
+        net.rnn_clear_previous_state()
+        self._paged_names: list = []
+        self._pos_names: list = []
+        self._layer_by_name: dict = {}
+        self._page_token_bytes = 0
+        itemsize = np.dtype(net.conf.dtype).itemsize
+        for name, layer in net._stream_layers():
+            c = probe.get(name)
+            if not c:
+                continue
+            self._layer_by_name[name] = layer
+            if "kcache" in c and hasattr(layer, "init_paged_carry"):
+                self._paged_names.append(name)
+                h = layer.n_heads
+                self._page_token_bytes += 2 * h * (layer.n_out // h) \
+                    * itemsize
+            elif "cache_pos" in c and "kcache" not in c:
+                self._pos_names.append(name)
+            else:
+                raise ValueError(
+                    f"layer {name!r} streams through a carry the paged "
+                    "pool cannot host (expected attention kcache/vcache "
+                    "or a bare cache_pos counter)")
+        if not self._paged_names or cap is None:
+            raise ValueError(
+                "net has no seedable streaming KV carry — GenerationServer "
+                "serves KV-cache streaming language models (TransformerLM)")
+        if cap % self._ps:
+            raise ValueError(
+                f"page_size {self._ps} must divide the KV-cache capacity "
+                f"{cap} (attention max_cache) so the paged view is bit-"
+                "identical to the contiguous cache")
+        self._capacity = cap
+        self._cap_tokens = cap
+        self._np = cap // self._ps
+
+    def _probe_draft(self):
+        draft = self._draft
+        draft.rnn_clear_previous_state()
+        probe = draft._seed_streaming_carry(1)
+        dcap = draft._stream_capacity
+        draft.rnn_clear_previous_state()
+        self._d_attn_names: list = []
+        self._d_pos_names: list = []
+        for name, layer in draft._stream_layers():
+            c = probe.get(name)
+            if not c:
+                continue
+            if "kcache" in c:
+                self._d_attn_names.append(name)
+            elif "cache_pos" in c:
+                self._d_pos_names.append(name)
+        if not self._d_attn_names or dcap is None:
+            raise ValueError("draft_net has no seedable streaming KV "
+                             "carry — speculative decoding needs a "
+                             "KV-cache streaming draft model")
+        if dcap < self._cap_tokens:
+            raise ValueError(
+                f"draft_net max_cache {dcap} < target capacity "
+                f"{self._cap_tokens}: the draft must reach every "
+                "position the target can")
+        self._draft_cap = dcap
+
     # ----------------------------------------------------------- programs
     def _fresh_pool(self):
-        """ONE pre-allocated pooled carry of leading dim ``slots``; the
-        per-vertex scalar stream counters become [S] vectors so every
-        slot decodes at its own depth inside one program."""
+        """The donated device carry: one [pages, H, page_size, d] K/V
+        pool per attention layer. Positions and block tables are HOST
+        state threaded in per dispatch, so this is all the device
+        keeps."""
         import jax
         import jax.numpy as jnp
 
-        net = self.net
-        net.rnn_clear_previous_state()
-        seed = net._seed_streaming_carry(self.slots)
-        self._capacity = net._stream_capacity
-        net.rnn_clear_previous_state()
-        if not seed:
-            return None
-        pool = {}
-        for vname, vdict in seed.items():
-            pool[vname] = {
-                k: (jnp.zeros((self.slots,), jnp.int32) if k == "cache_pos"
-                    else v)
-                for k, v in vdict.items()}
+        dtype = jnp.dtype(self.net.conf.dtype)
+        pool = {name: self._layer_by_name[name].init_paged_carry(
+            self.pages_total, self._ps, dtype)
+            for name in self._paged_names}
         return jax.device_put(pool)
 
-    def _donate(self):
-        # the pooled carry (arg 2 of both programs) is donated back every
-        # dispatch so the KV pool updates IN PLACE — without it each step
-        # copies every cache leaf just to rewrite one column. XLA treats
-        # an un-donatable buffer as copy + warning, never an error, and
-        # CPU/TPU both alias here (verified: same buffer pointer back)
-        return (2,)
+    def _fresh_draft_pool(self):
+        """Dense [S, H, cap, d] slot caches for the draft model (the
+        draft is small — paging it would buy little and cost a second
+        block table)."""
+        import jax
+
+        draft = self._draft
+        draft.rnn_clear_previous_state()
+        seed = draft._seed_streaming_carry(self.slots)
+        draft.rnn_clear_previous_state()
+        dpool = {name: {"kcache": seed[name]["kcache"],
+                        "vcache": seed[name]["vcache"]}
+                 for name in self._d_attn_names}
+        return jax.device_put(dpool)
 
     def _decode_program(self):
-        """The single decode step: one-hot feedback of each slot's last
-        token, one streaming forward over the pool, traced per-slot
-        sampling. Compiled ONCE — occupancy, positions, and sampling
-        params are all data, not shape."""
+        """The fused decode dispatch: ``steps_per_dispatch`` micro-steps
+        of one-hot feedback + streaming forward + traced per-slot
+        sampling, scanned on device so the host pays one round trip per
+        M tokens. Compiled ONCE — occupancy, positions, block tables and
+        sampling params are all data, not shape.
+
+        The page pool is gathered into a dense ``[S, H, Tmax, d]`` view
+        ONCE per dispatch, the M micro-steps run the per-row DENSE
+        streaming path over that view (bit-identical math — the view is
+        exactly the cache a contiguous layout would hold), and each
+        micro-step's freshly written column is scattered into its page
+        as it is produced (a one-column in-place scatter inside the
+        donated scan — near-free, unlike a bulk read-modify-write at
+        dispatch end). Gathering per dispatch instead of per micro-step
+        is the difference between paying the page indirection once per M
+        tokens and once per token.
+
+        Rows write-clamp at the per-slot capacity: a row whose position
+        reaches ``NP * ps`` freezes (token, position, count all hold and
+        its column write is routed to the garbage page). Only overshoot
+        tokens past a request's ``max_tokens`` can hit the clamp — the
+        host truncates those anyway — so admission needs NO look-ahead
+        margin and ``steps_per_dispatch`` can exceed a request's
+        remaining budget safely."""
         import jax
         import jax.numpy as jnp
 
@@ -189,38 +449,106 @@ class GenerationServer:
                                                    sampled_next_token)
 
         net, vocab = self.net, self.vocab
-        key = ("gen_decode", self.slots, vocab)
+        m_steps = self.steps_per_dispatch
+        paged = tuple(self._paged_names)
+        pos_only = tuple(self._pos_names)
+        key = ("gen_decode", self.slots, vocab, m_steps)
 
         def build():
             fwd = lm_stream_forward(net)
             dtype = jnp.dtype(net.conf.dtype)
 
-            def step(params, state, carry, last, active, temp, topk,
-                     base_keys, counts):
-                x = jax.nn.one_hot(last, vocab, dtype=dtype)[:, None, :]
-                out, new_carry = fwd(params, state, x, carry)
-                # freeze empty slots' stream counters: their garbage
-                # writes then land on one fixed column forever instead of
-                # drifting toward the cache edge
-                for vname, vdict in new_carry.items():
-                    if "cache_pos" in vdict:
-                        old = carry[vname]["cache_pos"]
-                        vdict["cache_pos"] = jnp.where(
-                            active, vdict["cache_pos"], old)
-                keys = jax.vmap(jax.random.fold_in)(base_keys, counts)
-                nxt = sampled_next_token(out[:, 0], keys, temp, topk)
-                return new_carry, nxt
+            def gather(pages, bt):
+                S, NP = bt.shape
+                return pages[bt].transpose(0, 2, 1, 3, 4).reshape(
+                    S, pages.shape[1], NP * pages.shape[2],
+                    pages.shape[3])
 
-            return jax.jit(step, donate_argnums=self._donate())
+            def step(params, state, pool, bt, positions, last, active,
+                     temp, topk, base_keys, counts):
+                views = {vn: (gather(pool[vn]["kpages"], bt),
+                              gather(pool[vn]["vpages"], bt))
+                         for vn in paged}
+                first = next(iter(paged))
+                ps = pool[first]["kpages"].shape[2]
+                cap = bt.shape[1] * ps
+
+                def body(cs, _):
+                    views, pool, pos, cur, cnt = cs
+                    # write-clamp: overshoot rows at capacity freeze
+                    act = active & (pos < cap)
+                    posw = jnp.minimum(pos, cap - 1)
+                    carry = {}
+                    for vn in pos_only:
+                        carry[vn] = {"cache_pos": posw}
+                    for vn in paged:
+                        carry[vn] = {"kcache": views[vn][0],
+                                     "vcache": views[vn][1],
+                                     "cache_pos": posw}
+                    x = jax.nn.one_hot(cur, vocab, dtype=dtype)[:, None, :]
+                    out, nc = fwd(params, state, x, carry)
+                    views = {vn: (nc[vn]["kcache"], nc[vn]["vcache"])
+                             for vn in paged}
+                    # scatter the column this step wrote into its page:
+                    # in-place inside the donated scan. Frozen/inactive
+                    # rows land on the garbage page (COW upstream keeps
+                    # real targets exclusively owned)
+                    pg = jnp.take_along_axis(
+                        bt, (posw // ps)[:, None], axis=1)[:, 0]
+                    pg = jnp.where(act, pg, 0)
+                    off = posw % ps
+                    cidx = posw[:, None, None, None]
+                    for vn in paged:
+                        kc, vc = views[vn]
+                        kcol = jnp.take_along_axis(kc, cidx, axis=2)
+                        vcol = jnp.take_along_axis(vc, cidx, axis=2)
+                        pool[vn] = {
+                            "kpages": pool[vn]["kpages"].at[
+                                pg, :, off, :].set(kcol[:, :, 0, :]),
+                            "vpages": pool[vn]["vpages"].at[
+                                pg, :, off, :].set(vcol[:, :, 0, :])}
+
+                    # all-greedy batches skip the PRNG fold-ins and the
+                    # full-vocab sort entirely — lax.cond picks the branch
+                    # at RUN time, so mixed batches still share this one
+                    # program, and the greedy op is the same argmax
+                    # sampled_next_token takes for temp<=0 rows (bit-exact)
+                    def _greedy(out0):
+                        return jnp.argmax(out0, axis=-1).astype(jnp.int32)
+
+                    def _sampled(out0):
+                        keys = jax.vmap(jax.random.fold_in)(base_keys, cnt)
+                        return sampled_next_token(
+                            out0, keys, temp, topk).astype(jnp.int32)
+
+                    nxt = jax.lax.cond(jnp.all(temp <= 0.0),
+                                       _greedy, _sampled, out[:, 0])
+                    # frozen rows hold: token, position and count all
+                    # stall so their garbage stays on the garbage page
+                    # (cast: argmax may widen to int64 under x64 mode)
+                    nxt = jnp.where(act, nxt, cur).astype(cur.dtype)
+                    pos = jnp.where(act, pos + 1, pos)
+                    cnt = jnp.where(act, cnt + 1, cnt)
+                    return (views, pool, pos, nxt, cnt), nxt
+
+                (_, pool, _, _, _), seq = jax.lax.scan(
+                    body, (views, pool, positions, last, counts), None,
+                    length=m_steps)
+                return pool, seq.T                         # [S, M]
+
+            return jax.jit(step, donate_argnums=(2,))
 
         return net._get_output(key, build)
 
     def _prefill_program(self, bucket: int):
-        """Prefill-into-slot for one prompt bucket: consume the (right-
-        padded, masked) prompt with a fresh batch-1 carry, sample the
-        first token from the last TRUE position, scatter the filled
-        caches into pool row ``slot`` and set its length watermark to the
-        true prompt length. One program per pow2 bucket."""
+        """Batched suffix prefill for one page-aligned bucket: every
+        slot admitted this wave consumes its (right-padded, masked)
+        suffix at its shared-prefix offset through ONE paged forward —
+        KV lands directly in each slot's pages, weights are read once
+        for the whole wave instead of once per request — and samples its
+        first token from its last TRUE position. Non-admitted rows
+        (free, or mid-decode) ride along as zero rows with their writes
+        routed to the garbage page. One program per bucket."""
         import jax
         import jax.numpy as jnp
 
@@ -228,38 +556,197 @@ class GenerationServer:
                                                    sampled_next_token)
 
         net, vocab = self.net, self.vocab
+        paged = tuple(self._paged_names)
+        pos_only = tuple(self._pos_names)
         key = ("gen_prefill", self.slots, vocab, bucket)
 
         def build():
             fwd = lm_stream_forward(net)
 
-            def prefill(params, state, pool, slot, prompt_onehot, mask,
-                        plen, temp, topk, base_key):
-                one = {}
-                for vname, vdict in pool.items():
-                    one[vname] = {
-                        k: (jnp.zeros((), jnp.int32) if k == "cache_pos"
-                            else jnp.zeros((1,) + v.shape[1:], v.dtype))
-                        for k, v in vdict.items()}
-                out, c1 = fwd(params, state, prompt_onehot, one, mask)
-                probs = out[0, plen - 1]
-                k0 = jax.random.fold_in(base_key, 0)
-                first = sampled_next_token(probs[None], k0[None],
-                                           temp[None], topk[None])[0]
-                new_pool = {}
-                for vname, vdict in pool.items():
-                    nv = {}
-                    for k, v in vdict.items():
-                        if k == "cache_pos":
-                            nv[k] = v.at[slot].set(plen)
-                        else:
-                            nv[k] = v.at[slot].set(c1[vname][k][0])
-                    new_pool[vname] = nv
+            def prefill(params, state, pool, bt, pos0, onehot, mask,
+                        sufflen, temp, topk, base_keys, admit):
+                # non-admitted rows write the garbage page — an active
+                # decode slot in the same batch must NOT have its real
+                # pages clobbered by its zero-row ride-along
+                bt_eff = jnp.where(admit[:, None], bt, 0)
+                carry = {}
+                for vn in pos_only:
+                    carry[vn] = {"cache_pos": pos0}
+                for vn in paged:
+                    carry[vn] = {"kpages": pool[vn]["kpages"],
+                                 "vpages": pool[vn]["vpages"],
+                                 "block_table": bt_eff,
+                                 "cache_pos": pos0}
+                out, nc = fwd(params, state, onehot, carry, mask)
+                new_pool = {vn: {"kpages": nc[vn]["kpages"],
+                                 "vpages": nc[vn]["vpages"]}
+                            for vn in paged}
+                rows = jnp.take_along_axis(
+                    out, (sufflen - 1)[:, None, None], axis=1)[:, 0]
+                k0 = jax.vmap(jax.random.fold_in)(
+                    base_keys, jnp.zeros_like(sufflen))
+                first = sampled_next_token(rows, k0, temp, topk)
                 return new_pool, first
 
-            return jax.jit(prefill, donate_argnums=self._donate())
+            return jax.jit(prefill, donate_argnums=(2,))
 
         return net._get_output(key, build)
+
+    def _page_copy_program(self):
+        """Copy-on-write: duplicate one pool page (all layers) into a
+        fresh page. Traced page ids — compiled once."""
+        import jax
+
+        paged = tuple(self._paged_names)
+        key = ("gen_page_copy",)
+
+        def build():
+            def copy(pool, src, dst):
+                out = {}
+                for vn in paged:
+                    kp = pool[vn]["kpages"]
+                    vp = pool[vn]["vpages"]
+                    out[vn] = {"kpages": kp.at[dst].set(kp[src]),
+                               "vpages": vp.at[dst].set(vp[src])}
+                return out
+
+            return jax.jit(copy, donate_argnums=(0,))
+
+        return self.net._get_output(key, build)
+
+    def _draft_prefill_program(self, bucket: int):
+        """Draft-side prefill for one pow2 token bucket: consume the full
+        (padded, masked) prompt with a fresh batch-1 dense carry and
+        scatter the filled caches into draft pool row ``slot``. No
+        sampling — the draft only needs its cache primed."""
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.models.zoo import lm_stream_forward
+
+        draft = self._draft
+        d_attn = tuple(self._d_attn_names)
+        d_pos = tuple(self._d_pos_names)
+        key = ("gen_draft_prefill", self.slots, self.vocab, bucket)
+
+        def build():
+            dfwd = lm_stream_forward(draft)
+
+            def dprefill(dparams, dstate, dpool, slot, onehot, mask):
+                one = {}
+                for vn in d_pos:
+                    one[vn] = {"cache_pos": jnp.zeros((), jnp.int32)}
+                for vn in d_attn:
+                    kc = dpool[vn]["kcache"]
+                    one[vn] = {
+                        "kcache": jnp.zeros((1,) + kc.shape[1:], kc.dtype),
+                        "vcache": jnp.zeros((1,) + kc.shape[1:], kc.dtype),
+                        "cache_pos": jnp.zeros((), jnp.int32)}
+                _, c1 = dfwd(dparams, dstate, onehot, one, mask)
+                return {vn: {
+                    "kcache": dpool[vn]["kcache"].at[slot].set(
+                        c1[vn]["kcache"][0]),
+                    "vcache": dpool[vn]["vcache"].at[slot].set(
+                        c1[vn]["vcache"][0])} for vn in d_attn}
+
+            return jax.jit(dprefill, donate_argnums=(2,))
+
+        return draft._get_output(key, build)
+
+    def _spec_program(self):
+        """One speculative round, fused: the draft scans K-1 proposal
+        steps over its dense cache (same fold_in key schedule the target
+        would use for those token indices), then the target verifies all
+        K positions in ONE chunked paged forward. Returns the target's
+        selections [S, K] and the per-slot count of leading draft
+        matches — everything the host needs to emit min(acc+1, K)
+        tokens, every one of them a TARGET selection under the serial
+        schedule (bit-exactness by construction)."""
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.models.zoo import (lm_stream_forward,
+                                                   sampled_next_token,
+                                                   spec_verify_tokens)
+
+        net, draft, vocab = self.net, self._draft, self.vocab
+        k_spec = self.spec_k
+        paged = tuple(self._paged_names)
+        pos_only = tuple(self._pos_names)
+        d_attn = tuple(self._d_attn_names)
+        d_pos = tuple(self._d_pos_names)
+        # the closure captures BOTH nets, so the program lives in the
+        # DRAFT's cache (it dies with the draft) keyed by the target's
+        # identity — a draft shared across servers never replays a
+        # program traced against a different target
+        key = ("gen_spec", id(net), self.slots, vocab, k_spec)
+
+        def build():
+            fwd = lm_stream_forward(net)
+            dfwd = lm_stream_forward(draft)
+            dtype = jnp.dtype(net.conf.dtype)
+
+            def dcarry(dp, pos):
+                carry = {}
+                for vn in d_pos:
+                    carry[vn] = {"cache_pos": pos}
+                for vn in d_attn:
+                    carry[vn] = {"kcache": dp[vn]["kcache"],
+                                 "vcache": dp[vn]["vcache"],
+                                 "cache_pos": pos}
+                return carry
+
+            def strip_d(nc):
+                return {vn: {"kcache": nc[vn]["kcache"],
+                             "vcache": nc[vn]["vcache"]} for vn in d_attn}
+
+            def spec(params, state, dparams, dstate, pool, dpool, bt,
+                     positions, last, active, temp, topk, base_keys,
+                     counts):
+                def body(cs, _):
+                    dp, pos, cur, cnt = cs
+                    x = jax.nn.one_hot(cur, vocab, dtype=dtype)[:, None, :]
+                    out, nc = dfwd(dparams, dstate, x, dcarry(dp, pos))
+                    keys = jax.vmap(jax.random.fold_in)(base_keys, cnt)
+                    prop = sampled_next_token(out[:, 0], keys, temp, topk)
+                    prop = jnp.where(active, prop, cur).astype(cur.dtype)
+                    return (strip_d(nc), jnp.where(active, pos + 1, pos),
+                            prop, jnp.where(active, cnt + 1, cnt)), prop
+
+                (dpool, pos_f, cur_f, _), props = jax.lax.scan(
+                    body, (dpool, positions, last, counts), None,
+                    length=k_spec - 1)
+                # feed the last proposal too (output unused): a
+                # full-accept round then leaves the draft cache
+                # hole-free at position pos + K - 1
+                x = jax.nn.one_hot(cur_f, vocab, dtype=dtype)[:, None, :]
+                _, nc = dfwd(dparams, dstate, x, dcarry(dpool, pos_f))
+                dpool = strip_d(nc)
+
+                drafts = props.T                         # [S, K-1]
+                chunk = jnp.concatenate([last[:, None], drafts], axis=1)
+                x = jax.nn.one_hot(chunk, vocab, dtype=dtype)  # [S, K, V]
+                carry = {}
+                for vn in pos_only:
+                    carry[vn] = {"cache_pos": positions}
+                for vn in paged:
+                    carry[vn] = {"kpages": pool[vn]["kpages"],
+                                 "vpages": pool[vn]["vpages"],
+                                 "block_table": bt,
+                                 "cache_pos": positions}
+                out, nc = fwd(params, state, x, carry)   # [S, K, V]
+                new_pool = {vn: {"kpages": nc[vn]["kpages"],
+                                 "vpages": nc[vn]["vpages"]}
+                            for vn in paged}
+                true = spec_verify_tokens(out, base_keys, counts, temp,
+                                          topk)          # [S, K]
+                match = (drafts == true[:, :k_spec - 1]).astype(jnp.int32)
+                acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+                return new_pool, dpool, true, acc
+
+            return jax.jit(spec, donate_argnums=(4, 5))
+
+        return draft._get_output(key, build)
 
     # ------------------------------------------------------------- submit
     def submit(self, prompt_ids, max_tokens: int, *,
@@ -268,8 +755,10 @@ class GenerationServer:
         """Queue one generation request; returns a Future resolving to
         the generated ids ([<= max_tokens] numpy int array — shorter when
         the per-request ``eos_id`` / server default is produced, which is
-        included). Raises ``ServerOverloaded`` past the admission
-        watermark and ``CircuitOpen`` while dispatches are failing."""
+        included). Raises a typed ``ServerOverloaded`` when the request
+        cannot fit the page budget (up front — never mid-prefill after a
+        slot is consumed) or past the admission watermark, and
+        ``CircuitOpen`` while dispatches are failing."""
         prompt = np.asarray(prompt_ids)
         if prompt.ndim != 1 or prompt.shape[0] < 1:
             raise ValueError(f"prompt_ids must be a non-empty 1-D id "
@@ -282,15 +771,26 @@ class GenerationServer:
             raise ValueError(f"top_k must be in [0, {self.vocab}], "
                              f"got {top_k}")
         plen = int(prompt.shape[0])
-        bucket = bucket_length(plen, minimum=self.min_prefill_bucket,
-                               maximum=self._capacity)
-        if self._capacity is not None:
-            needed = max(bucket, plen + int(max_tokens) - 1)
-            if needed > self._capacity:
-                raise ValueError(
-                    f"prompt + generated positions ({needed}) exceed the "
-                    f"KV-cache capacity ({self._capacity}); raise "
-                    "SelfAttentionLayer.max_cache or lower max_tokens")
+        # page-budget feasibility, up front: prompt + generated positions
+        # (+ the speculative look-ahead margin a verify chunk writes —
+        # the plain decode dispatch write-clamps at capacity, so it
+        # needs none) must fit the block table AND the pool with the
+        # garbage page excluded; prefill padding writes the garbage
+        # page, so buckets add no transient page pressure
+        margin = self.spec_k - 1 if self._draft is not None else 0
+        need_tokens = plen + int(max_tokens) + margin - 1
+        if need_tokens > self._cap_tokens:
+            raise ServerOverloaded(
+                f"infeasible request: prompt {plen} + max_tokens "
+                f"{max_tokens} (+{margin} look-ahead) exceeds the per-"
+                f"slot KV capacity {self._cap_tokens} "
+                f"({self._np} pages x {self._ps})")
+        need_pages = -(-need_tokens // self._ps)
+        if need_pages > self.pages_total - 1:
+            raise ServerOverloaded(
+                f"infeasible request: needs {need_pages} pages but the "
+                f"pool capacity is {self.pages_total - 1} usable pages "
+                f"of {self._ps} tokens")
         with self._cond:
             if self._closing:
                 raise RuntimeError("GenerationServer is closed")
@@ -329,7 +829,10 @@ class GenerationServer:
                     n_active = self._n_active
                 if n_active:
                     t0 = time.monotonic()
-                    self._decode_once()
+                    if self._draft is not None:
+                        self._spec_decode_once()
+                    else:
+                        self._decode_once()
                     with self._cond:
                         self._busy_s += time.monotonic() - t0
                 self._expire_active()
@@ -353,61 +856,361 @@ class GenerationServer:
         return None
 
     def _admit_free_slots(self):
+        """Admit every queued request a free slot and the page pool can
+        take, then prefill the whole wave together, one batched dispatch
+        per chunk round (Orca-style iteration-level scheduling: weights
+        are read once per round, not once per request)."""
+        staged = []                          # (slot, req, pos0, plen, t0)
         for s in range(self.slots):
             if self._slot_req[s] is not None:
                 continue
             req = self._pop_admittable()
             if req is None:
-                return
+                break
+            t0 = time.monotonic()
+            plen = req.prompt.shape[0]
             try:
-                self._prefill_into(s, req)
+                pos0 = self._stage_prompt_pages(s, req.prompt, plen)
+            except RuntimeError as e:  # pool exhausted during staging
+                self._release_slot_pages(s)
+                if staged:
+                    # transient pressure from this same admission wave:
+                    # requeue and batch what already staged — their
+                    # completions free the pages this request needs
+                    with self._cond:
+                        self._queue.appendleft(req)
+                    break
+                with self._cond:
+                    self._failed += 1
+                self._fail(req, e)
+                continue
             except Exception as e:  # noqa: BLE001 — typed failure for
                 # this request only; the slot stays free for the next one
+                self._release_slot_pages(s)
                 with self._cond:
                     if isinstance(e, DeadlineExceeded):
                         self._expired += 1
                     else:
                         self._failed += 1
                 self._fail(req, e)
+                continue
+            staged.append((s, req, pos0, plen, t0))
+        if staged:
+            self._prefill_wave(staged)
 
-    def _prefill_into(self, slot: int, req: _Request):
+    # -------------------------------------------------- page bookkeeping
+    def _release_slot_pages(self, slot: int):
+        sp = self._slot_pages[slot]
+        for page in sp:
+            self._page_pool.release(page)
+        sp.clear()
+        self._bt[slot, :] = 0
+        self._pos[slot] = 0
+
+    def _pick_victim(self, keep_slot: int):
+        best, best_seq = None, -1
+        for s in range(self.slots):
+            if s == keep_slot or self._slot_req[s] is None:
+                continue
+            if self._slot_seq[s] > best_seq:
+                best, best_seq = s, self._slot_seq[s]
+        return best
+
+    def _preempt(self, slot: int):
+        """Free the most recently admitted slot's pages under pool
+        pressure: its request is requeued at the FRONT with generated
+        tokens discarded — the deterministic key schedule regenerates
+        the identical completion on re-admission, so preemption is
+        invisible in outputs."""
+        req = self._slot_req[slot]
+        self._release_slot_pages(slot)
+        self._preempted += 1
+        req.tokens.clear()
+        with self._cond:
+            self._slot_req[slot] = None
+            self._n_active -= 1
+            self._queue.appendleft(req)
+            self._cond.notify_all()
+
+    def _alloc_page(self, for_slot: int) -> int:
+        while True:
+            page = self._page_pool.alloc()
+            if page is not None:
+                return page
+            victim = self._pick_victim(for_slot)
+            if victim is None:
+                raise RuntimeError(
+                    "page pool exhausted with nothing left to preempt — "
+                    "admission should have rejected this request")
+            self._preempt(victim)
+
+    def _ensure_writable(self, slot: int, idx: int):
+        """Copy-on-write: the slot is about to write into its idx-th
+        logical page; if that page is shared (or pinned pristine by the
+        prefix cache) copy it off and repoint the block table."""
+        sp = self._slot_pages[slot]
+        page = sp[idx]
+        if not self._page_pool.protected(page):
+            return
+        dst = self._alloc_page(slot)
+        prog = self._page_copy_program()
+        self._pool = prog(self._pool, np.int32(page), np.int32(dst))
+        self._cow_copies += 1
+        self._page_pool.release(page)
+        sp[idx] = dst
+        self._bt[slot, idx] = dst
+
+    def _ensure_slot_pages(self, slot: int, upto: int, write_from: int):
+        """Slot ``slot`` is about to write positions
+        [write_from, upto): allocate any missing pages and COW the
+        shared ones in the write range."""
+        sp = self._slot_pages[slot]
+        n = -(-upto // self._ps)
+        if n > self._np:
+            raise RuntimeError(
+                f"slot {slot} needs {n} pages > block table width "
+                f"{self._np} — admission should have rejected this")
+        while len(sp) < n:
+            page = self._alloc_page(slot)
+            self._bt[slot, len(sp)] = page
+            sp.append(page)
+        for idx in range(write_from // self._ps,
+                         (upto - 1) // self._ps + 1):
+            self._ensure_writable(slot, idx)
+
+    def _reserve_decode_pages(self):
+        """Page capacity for one decode dispatch: every active slot gets
+        pages covering its next ``lookahead`` writes (alloc + COW),
+        preempting the newest slots under pressure."""
+        look = self._lookahead
+        for s in range(self.slots):
+            if self._slot_req[s] is None:
+                continue
+            pos = int(self._pos[s])
+            # the dispatch write-clamps at capacity, so pages past the
+            # per-slot cap are never touched (overshoot lands on the
+            # garbage page)
+            upto = min(pos + look, self._cap_tokens)
+            if upto > pos:
+                self._ensure_slot_pages(s, upto, write_from=pos)
+
+    def _prefix_digest(self, digest: bytes, chunk) -> bytes:
+        return hashlib.sha1(digest + chunk.tobytes()).digest()
+
+    def _match_prefix(self, prompt, plen: int):
+        """Longest shared prefix already resident: full page-aligned
+        chunks under the chained digest, then the exact whole-prompt
+        tail. Returns (shared page list, matched token count) with the
+        shares already refcounted; at least one suffix token is always
+        left to prefill (the sampled first token needs a true
+        position)."""
+        if not self.prefix_cache:
+            return [], 0
+        pool = self._page_pool
+        ps = self._ps
+        digest = b""
+        pages: list = []
+        matched = 0
+        full = plen // ps
+        for i in range(full):
+            digest = self._prefix_digest(digest, prompt[i * ps:(i + 1) * ps])
+            page = pool.lookup(digest)
+            if page is None:
+                break
+            pages.append(page)
+            matched += ps
+        else:
+            rem = prompt[full * ps:]
+            if rem.size:
+                tkey = hashlib.sha1(digest + b"T" + rem.tobytes()).digest()
+                page = pool.lookup(tkey)
+                if page is not None:
+                    pages.append(page)
+                    matched = plen
+        if matched >= plen:
+            # whole prompt resident: un-share the final token — its
+            # 1-token suffix prefill writes into the shared page, which
+            # COWs off the slot's private copy (the genuine COW trigger)
+            matched = plen - 1
+        for page in pages:
+            pool.share(page)
+        return pages, matched
+
+    def _stage_prompt_pages(self, slot: int, prompt, plen: int):
+        """Assemble the slot's block-table row for prefill: adopt shared
+        prefix pages, then allocate private pages for the true suffix
+        tokens only — bucket padding inside a prefill round writes the
+        garbage page, so it needs no backing. Returns the suffix
+        offset."""
+        shared, matched = self._match_prefix(prompt, plen)
+        sp = self._slot_pages[slot]
+        sp.extend(shared)
+        for i, page in enumerate(shared):
+            self._bt[slot, i] = page
+        if matched:
+            self._prefix_hits += 1
+            self._prefix_tokens_reused += matched
+        self._ensure_slot_pages(slot, plen, write_from=matched)
+        return matched
+
+    def _trim_slot_pages(self, slot: int, plen: int):
+        """Drop prefill bucket over-allocation: pages wholly beyond the
+        next write position hold only padding garbage — return them to
+        the pool; decode re-allocates on demand."""
+        sp = self._slot_pages[slot]
+        keep = plen // self._ps + 1
+        while len(sp) > keep:
+            page = sp.pop()
+            self._bt[slot, len(sp)] = 0
+            self._page_pool.release(page)
+
+    def _register_prefix(self, slot: int, prompt, plen: int):
+        """Publish the slot's prompt pages in the prefix cache: full
+        page-aligned chunks under the chained digest, plus the whole-
+        prompt partial tail. Registered pages become copy-protected —
+        the first divergent write (this slot's own next decode token
+        included) COWs off a private copy, leaving the cached original
+        pristine for future sharers."""
+        if not self.prefix_cache:
+            return
+        sp = self._slot_pages[slot]
+        pool = self._page_pool
+        ps = self._ps
+        digest = b""
+        full = plen // ps
+        for i in range(full):
+            digest = self._prefix_digest(digest, prompt[i * ps:(i + 1) * ps])
+            pool.register(digest, sp[i])
+        rem = prompt[full * ps:]
+        if rem.size and full < len(sp):
+            tkey = hashlib.sha1(digest + b"T" + rem.tobytes()).digest()
+            pool.register(tkey, sp[full])
+
+    # ------------------------------------------------------ prefill path
+    def _prefill_wave(self, group):
+        """Batched chunked prefill for one admission wave: every staged
+        slot advances through rounds of at most ``prefill_chunk`` suffix
+        tokens, ONE dispatch per round for the rows with suffix left
+        (Sarathi-style chunked prefill — the transient per-round
+        activations stay bounded no matter how long the prompts are,
+        while weights are still read once per round for the whole wave).
+        Chunk boundaries are numerically transparent: each token's
+        attention reduces over exactly the columns at or before its true
+        position in the same order, so outputs are bit-identical to a
+        single full-length prefill. A row samples its first token in
+        the round consuming its final chunk; a dispatch failure fails
+        the whole wave typed (pages released, slots stay free)."""
         import jax
 
-        plen = int(req.prompt.shape[0])
-        bucket = bucket_length(plen, minimum=self.min_prefill_bucket,
-                               maximum=self._capacity)
-        prog = self._prefill_program(bucket)
         dtype = np.dtype(self.net.conf.dtype)
-        onehot = np.zeros((1, bucket, self.vocab), dtype)
-        onehot[0, np.arange(plen), req.prompt] = 1
-        mask = np.zeros((1, bucket), np.float32)
-        mask[0, :plen] = 1
-        base_key = np.asarray(jax.random.PRNGKey(req.seed), np.uint32)
-        dispatch = prog if self._chaos is None else self._chaos.wrap(prog)
+        S = self.slots
+        keys = np.zeros((S, 2), np.uint32)
+        cur = {}
+        first = {}
+        deadline = None
+        for s, req, pos0, _, _ in group:
+            cur[s] = pos0
+            keys[s] = jax.device_get(jax.random.PRNGKey(req.seed))
+            if req.deadline is not None and (
+                    deadline is None or req.deadline.remaining()
+                    < deadline.remaining()):
+                deadline = req.deadline
+        cap_pages = max(1, self._chunk_cap // self._ps)
+        while True:
+            live = [(s, req, plen) for s, req, _, plen, _ in group
+                    if cur[s] < plen]
+            if not live:
+                break
+            chunk = {s: min(plen - cur[s], self._chunk_cap)
+                     for s, _, plen in live}
+            target = max(max(chunk.values()), self.min_prefill_bucket)
+            bucket = bucket_pages(target, self._ps,
+                                  maximum=min(self._np, cap_pages)) * self._ps
+            prog = self._prefill_program(bucket)
+            onehot = np.zeros((S, bucket, self.vocab), dtype)
+            mask = np.zeros((S, bucket), np.float32)
+            admit = np.zeros((S,), bool)
+            positions = np.zeros((S,), np.int32)
+            sufflen = np.ones((S,), np.int32)
+            temp = np.zeros((S,), np.float32)
+            topk = np.zeros((S,), np.int32)
+            for s, req, _ in live:
+                n = chunk[s]
+                onehot[s, np.arange(n), req.prompt[cur[s]:cur[s] + n]] = 1
+                mask[s, :n] = 1
+                admit[s] = True
+                positions[s] = cur[s]
+                sufflen[s] = n
+                temp[s] = req.temperature
+                topk[s] = req.top_k
+            dispatch = prog if self._chaos is None \
+                else self._chaos.wrap(prog)
 
-        def attempt():
+            def attempt():
+                try:
+                    out = dispatch(self.net.params, self.net.state,
+                                   self._pool, self._bt, positions, onehot,
+                                   mask, sufflen, temp, topk, keys, admit)
+                except Exception:
+                    self.breaker.record_failure()
+                    raise
+                self.breaker.record_success()
+                return out
+
             try:
-                out = dispatch(self.net.params, self.net.state, self._carry,
-                               np.int32(slot), onehot, mask, np.int32(plen),
-                               np.float32(req.temperature),
-                               np.int32(req.top_k), base_key)
-            except Exception:
-                self.breaker.record_failure()
-                raise
-            self.breaker.record_success()
-            return out
+                new_pool, sampled = self.retry.call(
+                    attempt, deadline=deadline, on_retry=self._count_retry)
+            except Exception as e:  # noqa: BLE001 — typed failure for the
+                # wave; every staged slot stays free for the next one
+                for s, req, *_ in group:
+                    self._release_slot_pages(s)
+                    with self._cond:
+                        if isinstance(e, DeadlineExceeded):
+                            self._expired += 1
+                        else:
+                            self._failed += 1
+                    self._fail(req, e)
+                return
+            self._pool = new_pool
+            toks = jax.device_get(sampled).tolist()  # ONE fetch per round
+            for s, _, plen in live:
+                cur[s] += chunk[s]
+                if cur[s] >= plen:
+                    # this round consumed the row's final chunk, so its
+                    # sampled token came from the true last position;
+                    # earlier rounds' samples are padding garbage
+                    first[s] = toks[s]
+        for s, req, pos0, plen, t0 in group:
+            if self._draft is not None:
+                try:
+                    self._draft_prefill(s, req, plen)
+                except Exception as e:  # noqa: BLE001
+                    self._release_slot_pages(s)
+                    with self._cond:
+                        if isinstance(e, DeadlineExceeded):
+                            self._expired += 1
+                        else:
+                            self._failed += 1
+                    self._fail(req, e)
+                    continue
+            self._commit_slot(s, req, plen, first[s], keys[s], t0)
 
-        t0 = time.monotonic()
-        new_pool, first = self.retry.call(attempt, deadline=req.deadline,
-                                          on_retry=self._count_retry)
-        self._carry = new_pool
-        tok = int(first)
+    def _commit_slot(self, slot: int, req: _Request, plen: int, tok,
+                     key, t0: float):
+        """Publish one prefilled slot: trim the bucket over-allocation,
+        register its prefix pages, seed the decode mirrors, and mark the
+        slot active."""
+        self._trim_slot_pages(slot, plen)
+        self._register_prefix(slot, req.prompt, plen)
         self._last[slot] = tok
         self._counts[slot] = 1
         self._temp[slot] = req.temperature
         self._topk[slot] = req.top_k
-        self._keys[slot] = base_key
+        self._keys[slot] = key
+        self._pos[slot] = plen
         req.tokens.append(tok)
+        self._admit_seq += 1
+        self._slot_seq[slot] = self._admit_seq
         with self._cond:
             self._busy_s += time.monotonic() - t0
             self._prefills += 1
@@ -418,16 +1221,50 @@ class GenerationServer:
         if self._finished(req, tok):
             self._retire(slot, req)
 
-    def _decode_once(self):
-        prog = self._decode_program()
-        active = np.array([r is not None for r in self._slot_req])
+    def _draft_prefill(self, slot: int, req: _Request, plen: int):
+        """Prime the draft's dense cache row for ``slot`` with the full
+        prompt (the dense draft cache cannot share pages)."""
+        bucket = bucket_length(plen, minimum=self.min_prefill_bucket,
+                               maximum=self._draft_cap)
+        prog = self._draft_prefill_program(bucket)
+        dtype = np.dtype(self._draft.conf.dtype)
+        onehot = np.zeros((1, bucket, self.vocab), dtype)
+        onehot[0, np.arange(plen), req.prompt] = 1
+        mask = np.zeros((1, bucket), np.float32)
+        mask[0, :plen] = 1
         dispatch = prog if self._chaos is None else self._chaos.wrap(prog)
 
         def attempt():
             try:
-                out = dispatch(self.net.params, self.net.state, self._carry,
-                               self._last, active, self._temp, self._topk,
-                               self._keys, self._counts)
+                out = dispatch(self._draft.params, self._draft.state,
+                               self._dpool, np.int32(slot), onehot, mask)
+            except Exception:
+                self.breaker.record_failure()
+                raise
+            self.breaker.record_success()
+            return out
+
+        self._dpool = self.retry.call(attempt, deadline=req.deadline,
+                                      on_retry=self._count_retry)
+
+    # ------------------------------------------------------- decode path
+    def _active_mask(self):
+        return np.array([r is not None for r in self._slot_req])
+
+    def _decode_once(self):
+        import jax
+
+        prog = self._decode_program()
+        self._reserve_decode_pages()
+        active = self._active_mask()
+        dispatch = prog if self._chaos is None else self._chaos.wrap(prog)
+
+        def attempt():
+            try:
+                out = dispatch(self.net.params, self.net.state, self._pool,
+                               self._bt, self._pos, self._last, active,
+                               self._temp, self._topk, self._keys,
+                               self._counts)
             except Exception:
                 self.breaker.record_failure()
                 raise
@@ -435,38 +1272,105 @@ class GenerationServer:
             return out
 
         try:
-            new_carry, nxt = self.retry.call(attempt,
-                                             on_retry=self._count_retry)
-        except Exception as e:  # noqa: BLE001 — carry state is now
+            new_pool, seq = self.retry.call(attempt,
+                                            on_retry=self._count_retry)
+        except Exception as e:  # noqa: BLE001 — pool state is now
             # suspect (possibly donated away): fail the batch typed and
             # restart from a fresh pool so later requests still serve
             self._fail_all(e)
             return
-        self._carry = new_carry
-        toks = np.asarray(nxt)
+        self._pool = new_pool
+        toks = jax.device_get(seq)     # ONE [S, M] fetch per dispatch
+        m_steps = self.steps_per_dispatch
         ntok = 0
         for s in range(self.slots):
             req = self._slot_req[s]
             if req is None:
                 continue
-            tok = int(toks[s])
-            req.tokens.append(tok)
-            self._counts[s] += 1
-            self._last[s] = tok
-            ntok += 1
-            if self._finished(req, tok):
+            done = False
+            for tok in toks[s].tolist():
+                req.tokens.append(tok)
+                ntok += 1
+                if self._finished(req, tok):
+                    done = True
+                    break
+            # the device advanced the full window regardless of where
+            # the request finished; mirrors track the device (which
+            # write-clamps position and count at capacity)
+            adv = min(m_steps, self._cap_tokens - self._pos[s])
+            self._counts[s] += adv
+            self._pos[s] += adv
+            self._last[s] = toks[s, m_steps - 1]
+            if done:
                 self._retire(s, req)
         # ONE condition acquisition per decode step, not one per token
         with self._cond:
             self._decode_steps += 1
             self._tokens += ntok
 
-    def _finished(self, req: _Request, tok: int) -> bool:
+    def _spec_decode_once(self):
+        import jax
+
+        prog = self._spec_program()
+        self._reserve_decode_pages()
+        active = self._active_mask()
+        dispatch = prog if self._chaos is None else self._chaos.wrap(prog)
+
+        def attempt():
+            try:
+                out = dispatch(self.net.params, self.net.state,
+                               self._draft.params, self._draft.state,
+                               self._pool, self._dpool, self._bt,
+                               self._pos, self._last, active, self._temp,
+                               self._topk, self._keys, self._counts)
+            except Exception:
+                self.breaker.record_failure()
+                raise
+            self.breaker.record_success()
+            return out
+
+        try:
+            new_pool, new_dpool, true, acc = self.retry.call(
+                attempt, on_retry=self._count_retry)
+        except Exception as e:  # noqa: BLE001 — both pools suspect
+            self._fail_all(e)
+            return
+        self._pool = new_pool
+        self._dpool = new_dpool
+        true, acc = jax.device_get((true, acc))  # ONE fetch per round
+        k_spec = self.spec_k
+        ntok = 0
+        for s in range(self.slots):
+            req = self._slot_req[s]
+            if req is None:
+                continue
+            n = min(acc[s] + 1, k_spec)
+            self._spec_proposed += k_spec - 1
+            self._spec_accepted += n - 1
+            done = False
+            for tok in true[s, :n].tolist():
+                req.tokens.append(tok)
+                ntok += 1
+                if self._finished(req, tok):
+                    done = True
+                    break
+            self._counts[s] += n
+            self._pos[s] += n
+            self._last[s] = true[s, n - 1]
+            if done:
+                self._retire(s, req)
+        self._spec_rounds += 1
+        with self._cond:
+            self._decode_steps += 1
+            self._tokens += ntok
+
+    def _finished(self, req: _Request, tok) -> bool:
         if req.eos_id is not None and tok == req.eos_id:
             return True
         return len(req.tokens) >= req.max_tokens
 
     def _retire(self, slot: int, req: _Request):
+        self._release_slot_pages(slot)
         with self._cond:
             self._slot_req[slot] = None
             self._n_active -= 1
@@ -484,6 +1388,7 @@ class GenerationServer:
             if req is None or req.deadline is None \
                     or not req.deadline.expired():
                 continue
+            self._release_slot_pages(s)
             with self._cond:
                 self._slot_req[s] = None
                 self._n_active -= 1
@@ -500,8 +1405,9 @@ class GenerationServer:
             pass
 
     def _fail_all(self, exc: BaseException):
-        """Hard dispatch fault: every in-flight request fails typed (never
-        hangs) and the pooled carry is rebuilt from zeros."""
+        """Hard dispatch fault: every in-flight request fails typed
+        (never hangs) and the page pool + device carries are rebuilt
+        from zeros."""
         with self._cond:
             victims = [r for r in self._slot_req if r is not None]
             victims += list(self._queue)
@@ -512,7 +1418,16 @@ class GenerationServer:
             self._cond.notify_all()
         for req in victims:
             self._fail(req, exc)
-        self._carry = self._fresh_pool()
+        self._reset_device_state()
+
+    def _reset_device_state(self):
+        self._page_pool = _PagePool(self.pages_total)
+        self._bt[:] = 0
+        self._pos[:] = 0
+        self._slot_pages = [[] for _ in range(self.slots)]
+        self._pool = self._fresh_pool()
+        if self._draft is not None:
+            self._dpool = self._fresh_draft_pool()
 
     def _count_retry(self, attempt, exc):
         with self._cond:
@@ -536,7 +1451,8 @@ class GenerationServer:
     def close(self, timeout: float = 30.0) -> None:
         """Stop admitting, drain what is in flight, stop the loop. Any
         request still unresolved past ``timeout`` fails typed — a closed
-        server never leaves a hung future behind."""
+        server never leaves a hung future behind (and never leaks its
+        pages)."""
         with self._cond:
             if self._closing and self._stop:
                 return
@@ -548,11 +1464,15 @@ class GenerationServer:
             self._cond.notify_all()
         self._thread.join(timeout=max(timeout, 1.0))
         with self._cond:
-            victims = [r for r in self._slot_req if r is not None]
+            stragglers = [s for s in range(self.slots)
+                          if self._slot_req[s] is not None]
+            victims = [self._slot_req[s] for s in stragglers]
             victims += list(self._queue)
             self._queue.clear()
             self._slot_req = [None] * self.slots
             self._n_active = 0
+        for s in stragglers:   # loop thread is joined: safe to touch
+            self._release_slot_pages(s)
         for req in victims:
             self._fail(req, RuntimeError("GenerationServer closed with "
                                          "the request still in flight"))
@@ -560,7 +1480,8 @@ class GenerationServer:
     # ------------------------------------------------------------- stats
     def stats(self) -> dict:
         """Serving counters: the observable surface for /stats, the
-        bench, and ops."""
+        bench, and ops. The ``pages`` block carries the paged-KV gauges
+        (pool occupancy, sharing, COW, speculative accept rate)."""
         with self._cond:
             out = {
                 "slots": self.slots,
@@ -582,4 +1503,29 @@ class GenerationServer:
                    rejected=self.admission.rejected,
                    pending=self.admission.pending,
                    breaker_state=self.breaker.state)
+        # page/spec gauges are loop-thread-owned (read unlocked, like
+        # _slot_req): a racy snapshot, never a torn structure
+        pool = self._page_pool
+        proposed = int(self._spec_proposed)
+        accepted = int(self._spec_accepted)
+        out["pages"] = {
+            "page_size": self._ps,
+            "pages_total": pool.total,
+            "pages_free": len(pool.free),
+            "pages_cached": len(pool.cache),
+            "pages_shared": pool.shared_count(),
+            "pages_refcounted": pool.refcounted(),
+            "resident_kv_bytes": pool.in_use() * self._page_bytes,
+            "peak_resident_kv_bytes": pool.peak * self._page_bytes,
+            "cow_copies": int(self._cow_copies),
+            "prefix_hits": int(self._prefix_hits),
+            "prefix_tokens_reused": int(self._prefix_tokens_reused),
+            "evictions": int(pool.evictions),
+            "preempted": int(self._preempted),
+            "spec_k": self.spec_k if self._draft is not None else 0,
+            "spec_rounds": int(self._spec_rounds),
+            "spec_proposed": proposed,
+            "spec_accepted": accepted,
+            "spec_accept_rate": (accepted / proposed) if proposed else 0.0,
+        }
         return out

@@ -139,7 +139,9 @@ class TestGenerationCorrectness:
                 srv.submit(np.array([1, 2]), 4, temperature=-1.0)
             with pytest.raises(ValueError, match="top_k"):
                 srv.submit(np.array([1, 2]), 4, top_k=V + 1)
-            with pytest.raises(ValueError, match="capacity"):
+            # infeasible size is a typed, shed-able overload — admission
+            # rejects up front, never mid-prefill after a slot is burned
+            with pytest.raises(ServerOverloaded, match="capacity"):
                 srv.submit(np.array([1, 2]), 100000)
 
     def test_rejects_model_without_kv_carry(self):
@@ -168,22 +170,25 @@ class TestGenerationCorrectness:
 @pytest.mark.generation
 class TestGenerationScheduling:
     def test_no_recompile_on_occupancy_churn(self):
-        """The whole point of slot pooling: after warmup (ONE decode
-        program + one prefill program per pow2 prompt bucket), arbitrary
-        occupancy churn — admits, retirements, mixed lengths, idle slots
-        — adds ZERO compiled programs."""
+        """The whole point of page pooling: after warmup (ONE decode
+        program, one prefill program per PAGE bucket, one page-copy
+        program), arbitrary occupancy churn — admits, retirements, mixed
+        lengths, idle slots, page sharing and COW — adds ZERO compiled
+        programs. Block tables, positions and refcounts are all data."""
         net = TransformerLM(num_labels=V, max_length=16, d_model=8,
                             n_heads=2, n_blocks=1, seed=9).init()
         rs = np.random.RandomState(0)
         with serving(net, V, slots=3, min_prefill_bucket=4) as srv:
             base = len(net._output_cache)
-            warm = [srv.submit(rs.randint(0, V, 3), 5),   # bucket 4
-                    srv.submit(rs.randint(0, V, 7), 2)]   # bucket 8
+            warm = [srv.submit(rs.randint(0, V, 3), 5),
+                    srv.submit(rs.randint(0, V, 7), 2)]
             for f in warm:
                 f.result(timeout=120)
             warmed = len(net._output_cache)
-            # decode step + the two prefill buckets, nothing else
-            assert warmed - base == 1 + 2
+            # the decode step, the 1-page prefill bucket (every prompt
+            # here covers one page, so they ALL share one program), and
+            # the COW page-copy — nothing else
+            assert warmed - base == 3
 
             churn = [(4, 3), (2, 7), (6, 1), (8, 4), (3, 2), (5, 6)]
             futs = []
@@ -414,3 +419,228 @@ class TestGenerationLockDiscipline:
         # acquisition per decode step (not one per token)
         assert st["tokens_generated"] == sum(len(o) for o in outs)
         assert 1 <= st["decode_steps"] <= 4 * 3
+
+
+@pytest.mark.generation
+class TestPagedSharing:
+    """Paged-pool properties layered on the serving contract: prefix
+    sharing with copy-on-write parity, page-budget admission, preemption
+    under pool pressure, and refcounts draining to zero when the server
+    empties."""
+
+    def test_prefix_sharing_cow_parity(self, lm):
+        """Two prompts sharing a 32-token (two-page) prefix: the second
+        adopts the first's registered pages read-only and prefills only
+        its suffix — outputs stay BIT-identical to the serial references
+        because every divergent write copies the page off first."""
+        rs = np.random.RandomState(21)
+        pre = rs.randint(0, V, 32)
+        p1 = np.concatenate([pre, rs.randint(0, V, 5)])
+        p2 = np.concatenate([pre, rs.randint(0, V, 7)])
+        r1 = greedy_generate(lm, p1[None], 4, V)[0]
+        r2 = greedy_generate(lm, p2[None], 4, V)[0]
+        with serving(lm, V, slots=2) as srv:
+            np.testing.assert_array_equal(
+                srv.submit(p1, 4).result(timeout=120), r1)
+            np.testing.assert_array_equal(
+                srv.submit(p2, 4).result(timeout=120), r2)
+            pg = srv.stats()["pages"]
+        assert pg["prefix_hits"] >= 1
+        assert pg["prefix_tokens_reused"] >= 32     # both prefix pages
+        assert pg["cow_copies"] >= 1                # divergence copied off
+
+    def test_identical_prompt_tail_page_shared(self, lm):
+        """A byte-identical re-submission (same seed) reuses everything
+        up to the LAST prompt token — the partial tail page is shared via
+        the whole-prompt digest — and still matches exactly."""
+        rs = np.random.RandomState(22)
+        p = rs.randint(0, V, 11)                    # sub-page prompt
+        ref = greedy_generate(lm, p[None], 5, V)[0]
+        with serving(lm, V, slots=2) as srv:
+            np.testing.assert_array_equal(
+                srv.submit(p, 5).result(timeout=120), ref)
+            np.testing.assert_array_equal(
+                srv.submit(p, 5).result(timeout=120), ref)
+            pg = srv.stats()["pages"]
+        assert pg["prefix_hits"] == 1
+        assert pg["prefix_tokens_reused"] == 10     # plen - 1
+
+    def test_refcounts_drain_when_idle(self, lm):
+        """After every request resolves, no page is refcounted: the pool
+        is free pages + reclaimable prefix-cache pages, nothing leaked."""
+        rs = np.random.RandomState(23)
+        with serving(lm, V, slots=3) as srv:
+            futs = [srv.submit(rs.randint(0, V, 4 + i), 3)
+                    for i in range(5)]
+            for f in futs:
+                f.result(timeout=120)
+            assert srv.drain(timeout=60)
+            pg = srv.stats()["pages"]
+        assert pg["pages_refcounted"] == 0
+        assert pg["pages_free"] + pg["pages_cached"] \
+            == pg["pages_total"] - 1                # all but garbage page
+
+    def test_page_budget_admission(self, lm):
+        """submit() validates the whole-lifetime page need against the
+        pool budget up front: an infeasible request is a typed
+        ServerOverloaded before any slot or page is consumed, and a
+        feasible one on the same server still serves exactly."""
+        rs = np.random.RandomState(24)
+        p = rs.randint(0, V, 3)
+        ref = greedy_generate(lm, p[None], 4, V)[0]
+        with serving(lm, V, slots=2, pages=4) as srv:  # 3 usable pages
+            with pytest.raises(ServerOverloaded, match="page"):
+                srv.submit(p, 60)                   # needs 4 pages
+            np.testing.assert_array_equal(
+                srv.submit(p, 4).result(timeout=120), ref)
+            st = srv.stats()
+        assert st["completed"] == 1 and st["failed"] == 0
+
+    def test_preemption_under_pool_pressure(self, lm):
+        """Two long requests whose combined page need exceeds the pool:
+        the newest slot is preempted (pages freed, request requeued at
+        the FRONT) — and because decode is deterministic under the
+        fold_in key schedule, BOTH still complete bit-exactly."""
+        rs = np.random.RandomState(25)
+        pa = rs.randint(0, V, 40)                   # 3 pages of prompt
+        pb = rs.randint(0, V, 40)
+        ra = greedy_generate(lm, pa[None], 30, V)[0]
+        rb = greedy_generate(lm, pb[None], 30, V)[0]
+        # each request needs 5 pages end to end; 9 usable < 10 combined
+        with serving(lm, V, slots=2, pages=10, prefix_cache=False) as srv:
+            fa = srv.submit(pa, 30)
+            fb = srv.submit(pb, 30)
+            np.testing.assert_array_equal(fa.result(timeout=180), ra)
+            np.testing.assert_array_equal(fb.result(timeout=180), rb)
+            st = srv.stats()
+        assert st["pages"]["preempted"] >= 1
+        assert st["completed"] == 2 and st["failed"] == 0
+
+    def test_lru_eviction_reclaims_cached_pages(self, lm):
+        """Prefix-cache pages are reclaimable, not leaked: when the free
+        list runs dry the oldest unreferenced cached page is evicted to
+        serve new allocations, and serving continues exactly."""
+        rs = np.random.RandomState(26)
+        prompts = [rs.randint(0, V, 16) for _ in range(6)]
+        refs = [greedy_generate(lm, p[None], 3, V)[0] for p in prompts]
+        # 6 distinct one-page prompts through a 4-usable-page pool: the
+        # prefix cache must evict to keep admitting
+        with serving(lm, V, slots=1, pages=5) as srv:
+            for p, ref in zip(prompts, refs):
+                np.testing.assert_array_equal(
+                    srv.submit(p, 3).result(timeout=120), ref)
+            pg = srv.stats()["pages"]
+        assert pg["evictions"] >= 1
+        assert pg["pages_refcounted"] == 0
+
+
+@pytest.mark.generation
+class TestSpeculative:
+    """Speculative decoding: the draft proposes, the target verifies all
+    K positions in one chunked dispatch, and every emitted token is the
+    TARGET's selection under the serial fold_in schedule — so outputs are
+    bit-exact regardless of draft quality."""
+
+    def test_perfect_draft_all_accept(self, lm, greedy_refs):
+        """Draft == target: every proposal verifies, the accept rate is
+        ~1, and all completions match the serial references exactly."""
+        reqs, refs = greedy_refs
+        with serving(lm, V, slots=3, draft_net=lm, spec_k=3) as srv:
+            futs = [srv.submit(p, s) for p, s in reqs]
+            outs = [f.result(timeout=180) for f in futs]
+            pg = srv.stats()["pages"]
+        for got, ref in zip(outs, refs):
+            np.testing.assert_array_equal(got, ref)
+        assert pg["spec_rounds"] > 0
+        assert pg["spec_accept_rate"] > 0.9
+
+    def test_mismatched_draft_still_bit_exact(self, lm, greedy_refs):
+        """A draft with unrelated weights proposes mostly-rejected tokens:
+        throughput degrades, correctness does not — greedy AND sampled
+        completions still match the serial paths token-for-token."""
+        reqs, refs = greedy_refs
+        draft = TransformerLM(num_labels=V, max_length=16, d_model=8,
+                              n_heads=2, n_blocks=1, seed=99).init()
+        rs = np.random.RandomState(31)
+        sp = rs.randint(0, V, 4)
+        sref = sample_generate(lm, sp[None], 6, V, temperature=0.9,
+                               top_k=5, seed=7)[0]
+        with serving(lm, V, slots=3, draft_net=draft, spec_k=4) as srv:
+            futs = [srv.submit(p, s) for p, s in reqs]
+            fs = srv.submit(sp, 6, temperature=0.9, top_k=5, seed=7)
+            outs = [f.result(timeout=180) for f in futs]
+            sout = fs.result(timeout=180)
+            pg = srv.stats()["pages"]
+        for got, ref in zip(outs, refs):
+            np.testing.assert_array_equal(got, ref)
+        np.testing.assert_array_equal(sout, sref)
+        assert pg["spec_accept_rate"] < 1.0
+
+    def test_eos_mid_speculative_round(self, lm, greedy_refs):
+        """EOS produced inside a verified chunk truncates the emission at
+        (and including) the EOS token, exactly as the serial path."""
+        reqs, refs = greedy_refs
+        (p0, s0), ref0 = reqs[0], refs[0]
+        eos = int(ref0[3])
+        k = int(np.where(ref0 == eos)[0][0])
+        with serving(lm, V, slots=2, draft_net=lm, spec_k=4) as srv:
+            got = srv.submit(p0, s0, eos_id=eos).result(timeout=180)
+        np.testing.assert_array_equal(got, ref0[:k + 1])
+
+    def test_spec_zero_recompiles_on_churn(self):
+        """Speculative serving compiles one spec round + one draft
+        prefill per token bucket (on the DRAFT's cache) and one target
+        prefill per page bucket + the page copy (on the target's) — then
+        occupancy churn and accept/reject variation add ZERO programs."""
+        net = TransformerLM(num_labels=V, max_length=16, d_model=8,
+                            n_heads=2, n_blocks=1, seed=9).init()
+        draft = TransformerLM(num_labels=V, max_length=16, d_model=8,
+                              n_heads=2, n_blocks=1, seed=10).init()
+        rs = np.random.RandomState(32)
+        with serving(net, V, slots=3, draft_net=draft, spec_k=3) as srv:
+            nb, db = len(net._output_cache), len(draft._output_cache)
+            warm = [srv.submit(rs.randint(0, V, 3), 5),
+                    srv.submit(rs.randint(0, V, 7), 2)]
+            for f in warm:
+                f.result(timeout=180)
+            nw, dw = len(net._output_cache), len(draft._output_cache)
+            assert nw - nb == 2     # page-bucket prefill + page copy
+            assert dw - db == 2     # spec round + draft prefill bucket
+            churn = [(4, 3), (2, 7), (6, 1), (8, 4), (3, 2), (5, 6)]
+            futs = [srv.submit(rs.randint(0, V, plen), mt)
+                    for plen, mt in churn]
+            for f, (_plen, mt) in zip(futs, churn):
+                assert f.result(timeout=180).shape == (mt,)
+            assert len(net._output_cache) == nw
+            assert len(draft._output_cache) == dw
+
+    def test_draft_validation(self, lm):
+        """Constructor contract: spec_k < 2 and a draft that cannot reach
+        the target's positions are loud construction-time errors."""
+        with pytest.raises(ValueError, match="spec_k"):
+            GenerationServer(lm, V, slots=2, draft_net=lm, spec_k=1)
+
+
+@pytest.mark.generation
+class TestBucketPages:
+    """bucket_pages: the page-granular sibling of bucket_length that the
+    paged prefill keys its program cache on."""
+
+    def test_pow2_page_counts(self):
+        from deeplearning4j_tpu.optimize.bucketing import bucket_pages
+        assert bucket_pages(1, 16) == 1
+        assert bucket_pages(16, 16) == 1
+        assert bucket_pages(17, 16) == 2
+        assert bucket_pages(40, 16) == 4            # ceil 3 -> pow2 4
+        # distant token counts collapse onto one page bucket
+        assert bucket_pages(810, 16) == bucket_pages(900, 16) == 64
+
+    def test_maximum_caps_and_rejects(self):
+        from deeplearning4j_tpu.optimize.bucketing import bucket_pages
+        assert bucket_pages(70, 16, maximum=5) == 5  # pow2 8 capped at 5
+        with pytest.raises(ValueError, match="page budget"):
+            bucket_pages(81, 16, maximum=5)          # 81 > 5*16
+        with pytest.raises(ValueError, match="page_size"):
+            bucket_pages(8, 0)
+        with pytest.raises(ValueError, match="token"):
+            bucket_pages(0, 16)
