@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import json
 import sys
+import threading
 import time
 
 import numpy as np
@@ -591,6 +592,169 @@ def bench_serve_chaos(n_requests: int = 256, max_batch: int = 64,
     }
 
 
+def bench_serve_fleet(n_requests: int = 96, repeats: int = 3,
+                      window: int = 8, vocab: int = 17):
+    """Replica-fleet generation serving under chaos: ``n_requests`` mixed
+    greedy+sampled requests per pass through a ``ReplicaFleet`` of
+    ``GenerationServer`` replicas, a bounded client window (``window``
+    outstanding, typed sheds retried with backoff — the HTTP-client
+    contract), each replica carrying its own seeded ``ChaosPolicy`` at
+    ~10% injected faults (transient dispatch failures, stalls,
+    slow-decode) PLUS one explicit mid-stream ``kill_replica`` per timed
+    pass. Measures aggregate req/s at replicas=1 vs replicas=2 on the
+    SAME workload and asserts the fleet scales >= 1.7x.
+
+    The scaling is an availability win, not a FLOPs win (the bench box
+    may be one core): a lone replica takes the full outage on every kill
+    — restart backoff, re-prefill, re-decode of re-dispatched requests —
+    while the two-replica fleet routes around the death at nearly full
+    throughput and re-dispatches the victim's in-flight work to the
+    survivor. Every completion is checked BIT-identical to its serial
+    reference (the fold_in key schedule makes regeneration exact on any
+    replica) and the zero-lost-futures ledger is asserted from the fleet
+    counters — both in-bench, not in a separate test."""
+    from deeplearning4j_tpu.models.zoo import (TransformerLM,
+                                               greedy_generate,
+                                               sample_generate)
+    from deeplearning4j_tpu.parallel.fleet import READY, ReplicaFleet
+    from deeplearning4j_tpu.parallel.generation import GenerationServer
+    from deeplearning4j_tpu.parallel.resilience import (ChaosPolicy,
+                                                        ResilienceError)
+
+    net = TransformerLM(num_labels=vocab, max_length=16, d_model=16,
+                        n_heads=2, n_blocks=1, seed=3).init()
+    rng = np.random.default_rng(42)
+    shapes = [(3, 4), (5, 5), (4, 6)]  # (plen, steps): bounded programs
+    specs = []
+    for i in range(n_requests):
+        plen, steps = shapes[i % len(shapes)]
+        p = rng.integers(1, vocab, size=plen).astype(np.int64)
+        specs.append((p, steps, 0.0, 0, 0) if i % 2 == 0
+                     else (p, steps, 0.9, 5, 2000 + i))
+    refs = [greedy_generate(net, p[None], steps, vocab)[0]
+            if temp == 0.0 else
+            sample_generate(net, p[None], steps, vocab, temperature=temp,
+                            top_k=top_k, seed=seed)[0]
+            for p, steps, temp, top_k, seed in specs]
+
+    def factory(rid):
+        # ~10% of dispatches faulted, deterministic per replica slot
+        chaos = ChaosPolicy(seed=1000 + rid, transient_rate=0.04,
+                            stall_rate=0.03, stall_s=0.05,
+                            slow_rate=0.03, slow_factor=2.0)
+        return GenerationServer(net, vocab, slots=4, chaos=chaos)
+
+    def submit_retry(fl, spec):
+        p, steps, temp, top_k, seed = spec
+        t_end = time.monotonic() + SUB_BENCH_TIMEOUT_S
+        while True:
+            try:
+                return fl.submit(p, steps, temperature=temp, top_k=top_k,
+                                 seed=seed,
+                                 deadline_s=SUB_BENCH_TIMEOUT_S)
+            except ResilienceError:
+                # typed shed (replica restarting): back off and resubmit
+                if time.monotonic() > t_end:
+                    raise
+                time.sleep(0.01)
+
+    def run_pass(fl, kill):
+        sem = threading.BoundedSemaphore(window)
+        done_at = [None] * n_requests
+        t_submit = [None] * n_requests
+
+        def make_cb(i):
+            def cb(_fut):
+                done_at[i] = time.perf_counter()
+                sem.release()
+            return cb
+
+        t0 = time.perf_counter()
+        futs = []
+        for i, spec in enumerate(specs):
+            sem.acquire()
+            t_submit[i] = time.perf_counter()
+            f = submit_retry(fl, spec)
+            f.add_done_callback(make_cb(i))
+            futs.append(f)
+            if kill and i == n_requests // 3:
+                # mid-stream replica death: in-flight work re-dispatches
+                fl.kill_replica(0)
+        outs = [f.result(timeout=SUB_BENCH_TIMEOUT_S) for f in futs]
+        total = time.perf_counter() - t0
+        bad = sum(1 for o, ref in zip(outs, refs)
+                  if not np.array_equal(np.asarray(o), ref))
+        if bad:  # bit-exact across redispatch is the point of the metric
+            raise RuntimeError(
+                f"{bad}/{n_requests} fleet completions differ from their "
+                "serial references under chaos")
+        lat_ms = sorted((d - s) * 1e3
+                        for d, s in zip(done_at, t_submit))
+        return total, lat_ms
+
+    results = {}
+    for nrep in (1, 2):
+        fl = ReplicaFleet(factory, replicas=nrep,
+                          max_pending=2 * n_requests,
+                          replica_max_pending=2 * n_requests,
+                          restart_backoff_s=0.5)
+        try:
+            run_pass(fl, kill=False)  # warm every program, both paths
+            total = 0.0
+            lat_ms = None
+            for _ in range(repeats):
+                t, lat_ms = run_pass(fl, kill=True)
+                total += t
+            # let the supervised restart land (the backoff may outlive a
+            # fast pass) so the counters prove the full death->respawn arc
+            t_end = time.monotonic() + 30.0
+            st = fl.stats()
+            while (st["restarts"] < 1
+                   or any(r["state"] != READY for r in st["replicas"])):
+                if time.monotonic() > t_end:
+                    break
+                time.sleep(0.02)
+                st = fl.stats()
+        finally:
+            fl.close()
+        # zero-lost-futures ledger: every accepted request completed;
+        # typed sheds the client retried are rejected_submits, and
+        # nothing may be left parked, in flight, failed, or expired
+        lost = st["submitted"] - st["completed"] - st["rejected_submits"]
+        if lost or st["inflight"] or st["parked"] or st["failed"] \
+                or st["expired"]:
+            raise RuntimeError(
+                f"fleet leaked {lost} futures (inflight {st['inflight']}"
+                f", parked {st['parked']}, failed {st['failed']}, "
+                f"expired {st['expired']}) under chaos")
+        if st["deaths"] < 1 or st["restarts"] < 1:
+            raise RuntimeError(
+                "the explicit kill_replica never exercised the "
+                f"restart path (deaths {st['deaths']}, restarts "
+                f"{st['restarts']})")
+        results[nrep] = (repeats * n_requests / total, lat_ms, st)
+
+    req_s_1, _, _ = results[1]
+    req_s_2, lat_ms, st2 = results[2]
+    scaling = req_s_2 / req_s_1
+    if scaling < 1.7:
+        raise RuntimeError(
+            f"fleet 1->2 replica scaling {scaling:.2f}x under chaos — "
+            "below the 1.7x bar the health-weighted router exists to "
+            "clear")
+    return {
+        "serve_fleet_req_s": _sane("serve_fleet_req_s", req_s_2),
+        "serve_fleet_1rep_req_s": _sane("serve_fleet_1rep_req_s",
+                                        req_s_1),
+        "serve_fleet_scaling": scaling,
+        "serve_fleet_p50_ms": lat_ms[len(lat_ms) // 2],
+        "serve_fleet_p99_ms": lat_ms[int(len(lat_ms) * 0.99)],
+        "serve_fleet_deaths": float(st2["deaths"]),
+        "serve_fleet_restarts": float(st2["restarts"]),
+        "serve_fleet_redispatched": float(st2["redispatched"]),
+    }
+
+
 def bench_generate_serve(n_requests: int = 64, slots: int = 64,
                          vocab: int = 256, d_model: int = 256,
                          n_blocks: int = 3, repeats: int = 3):
@@ -901,6 +1065,8 @@ SANITY_CEILING = {
     "guard_off_img_s": 1e8,
     "inference_serve_req_s": 1e8,
     "serve_chaos_req_s": 1e8,
+    "serve_fleet_req_s": 1e8,
+    "serve_fleet_1rep_req_s": 1e8,
     "generate_serve_tokens_s": 1e9,
     "generate_serve_serial_tokens_s": 1e9,
     "generate_longtail_tokens_s": 1e9,
@@ -946,6 +1112,14 @@ METRIC_UNIT = {
     "serve_chaos_typed_failure_frac": "",
     "serve_chaos_retries": "",
     "serve_chaos_injected_faults": "",
+    "serve_fleet_req_s": "req/s",
+    "serve_fleet_1rep_req_s": "req/s",
+    "serve_fleet_scaling": "x",
+    "serve_fleet_p50_ms": "ms",
+    "serve_fleet_p99_ms": "ms",
+    "serve_fleet_deaths": "",
+    "serve_fleet_restarts": "",
+    "serve_fleet_redispatched": "",
     "generate_serve_tokens_s": "tokens/s",
     "generate_serve_serial_tokens_s": "tokens/s",
     "generate_serve_speedup": "x",
@@ -1184,7 +1358,7 @@ def main():
     valid = ("all", "resnet50", "vgg16", "lenet", "lstm", "transformer",
              "word2vec", "doc2vec", "attention", "fit_e2e", "eval_e2e",
              "guard_overhead", "inference_serve", "serve_chaos",
-             "generate_serve", "generate_longtail")
+             "serve_fleet", "generate_serve", "generate_longtail")
     if which not in valid:
         sys.exit(f"Unknown model '{which}'; choose one of {valid}")
     # persistent XLA compile cache: repeated bench runs skip the
@@ -1235,6 +1409,9 @@ def main():
     if which in ("all", "serve_chaos"):
         _sub_metric(extras, "serve_chaos", bench_serve_chaos)
         headline and headline.sample("post-serve-chaos")
+    if which in ("all", "serve_fleet"):
+        _sub_metric(extras, "serve_fleet", bench_serve_fleet)
+        headline and headline.sample("post-serve-fleet")
     if which in ("all", "generate_serve"):
         _sub_metric(extras, "generate_serve", bench_generate_serve)
     if which in ("all", "generate_longtail"):

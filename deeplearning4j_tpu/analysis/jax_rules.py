@@ -48,6 +48,8 @@ HOT_FUNCTIONS = {
     "_coalesce_loop", "_complete_loop",           # inference coalescer
     "_dispatch_batch", "_dispatch_fwd",           # inference dispatch
     "_run_block", "fit_stream",                   # fused-fit driver loop
+    "_route_once", "_replica_done",               # fleet router hot path
+    "_monitor_loop",                              # fleet redispatch/hedge
 }
 
 SYNC_BUILTINS = {"float", "bool", "int"}

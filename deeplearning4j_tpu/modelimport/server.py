@@ -51,6 +51,8 @@ from deeplearning4j_tpu.parallel.resilience import (AdmissionController,
                                                     CircuitBreaker,
                                                     CircuitOpen, Deadline,
                                                     DeadlineExceeded,
+                                                    ReplicaKilled,
+                                                    ReplicaUnavailable,
                                                     RetryPolicy,
                                                     ServerOverloaded,
                                                     TransientDispatchError)
@@ -65,8 +67,10 @@ class UnknownModelError(KeyError):
 #: error type -> HTTP status for the typed serving taxonomy
 _STATUS = {
     ServerOverloaded: 429,
-    CircuitOpen: 503,
+    CircuitOpen: 503,          # incl. every fleet replica breaker open
     TransientDispatchError: 503,  # retry budget spent on transient faults
+    ReplicaUnavailable: 503,   # whole fleet dead/draining/restarting
+    ReplicaKilled: 503,        # replica died and the failover budget ended
     DeadlineExceeded: 504,
 }
 
@@ -89,6 +93,11 @@ class KerasBackendServer:
         self._port = port
         self._models: dict = {}
         self._generators: dict = {}
+        self._inference: dict = {}
+        # leaf lock for the /predict server registry: predict() must not
+        # touch self._lock before admission (the legacy path holds it for
+        # the whole dispatch — the watermark could never 429)
+        self._inference_lock = threading.Lock()
         self._next_id = 0
         self._lock = threading.Lock()
         self._httpd = None
@@ -178,9 +187,29 @@ class KerasBackendServer:
         """The guarded serving entry: admission -> breaker gate -> model
         lock -> dispatch under retry, with the deadline re-checked at each
         stage boundary so a request whose budget died waiting never costs
-        a device program."""
+        a device program. Models registered with ``attach_inference``
+        route through their coalescing server (or replica fleet) instead —
+        its own admission/breaker/deadline typing maps onto the same
+        429/503/504 taxonomy."""
         budget = deadline_s if deadline_s is not None \
             else self.request_deadline_s
+        with self._inference_lock:
+            inf = self._inference.get(mid)
+        if inf is not None:
+            x = np.asarray(features, np.float32)
+            fut = inf.submit(x, deadline_s=budget)
+            try:
+                # the server resolves deadlined requests itself; the slack
+                # only guards a wedged server from hanging the HTTP thread
+                out = fut.result(timeout=None if budget is None
+                                 else budget + 30.0)
+            except Exception:
+                with self._stats_lock:
+                    self._failed += 1
+                raise
+            with self._stats_lock:
+                self._completed += 1
+            return np.asarray(out).tolist()
         deadline = None if budget is None else Deadline(budget)
         if not self.breaker.allow():
             with self._stats_lock:
@@ -222,7 +251,9 @@ class KerasBackendServer:
 
     def attach_generation(self, net, *, vocab: int, slots: int = 4,
                           eos_id: Optional[int] = None,
-                          mid: Optional[str] = None, **gen_kw) -> str:
+                          mid: Optional[str] = None, replicas: int = 1,
+                          fleet_kw: Optional[dict] = None,
+                          **gen_kw) -> str:
         """Register a causal LM for /generate, served by a paged
         ``GenerationServer`` (continuous batching over a page-pool
         KV-cache — parallel/generation.py). ``net`` may be a model
@@ -234,7 +265,15 @@ class KerasBackendServer:
         resilience (max_pending, request_deadline_s, retry, breaker,
         chaos, ...). Page-pool occupancy, prefix-cache reuse, COW, and
         speculation counters surface per model under ``pages`` in
-        /stats."""
+        /stats.
+
+        ``replicas > 1`` serves the model through a ``ReplicaFleet`` of
+        independent GenerationServers (health-routed failover, supervised
+        restart, zero lost futures across replica death — parallel/
+        fleet.py); ``fleet_kw`` forwards to the fleet (hedge_after_s,
+        restart_backoff_s, ...). The per-replica health/breaker/restart
+        block then appears under this model in /stats."""
+        from deeplearning4j_tpu.parallel.fleet import ReplicaFleet
         from deeplearning4j_tpu.parallel.generation import GenerationServer
 
         with self._lock:
@@ -248,10 +287,54 @@ class KerasBackendServer:
             old = self._generators.pop(mid, None)
         if old is not None:
             old.close()
-        gen = GenerationServer(net, vocab, slots=slots, eos_id=eos_id,
-                               **gen_kw)
+        if int(replicas) > 1:
+            def factory(rid):
+                return GenerationServer(net, vocab, slots=slots,
+                                        eos_id=eos_id, **gen_kw)
+            gen = ReplicaFleet(factory, replicas=int(replicas),
+                               **(fleet_kw or {}))
+        else:
+            gen = GenerationServer(net, vocab, slots=slots, eos_id=eos_id,
+                                   **gen_kw)
         with self._lock:
             self._generators[mid] = gen
+        return mid
+
+    def attach_inference(self, net, *, mid: Optional[str] = None,
+                         replicas: int = 1,
+                         fleet_kw: Optional[dict] = None,
+                         **inf_kw) -> str:
+        """Register a model for /predict behind a coalescing
+        ``ParallelInference`` server — or, with ``replicas > 1``, a
+        ``ReplicaFleet`` of them — instead of the default
+        lock-serialized direct dispatch. ``net`` may be a model instance
+        or an imported model id; ``inf_kw`` forwards to each
+        ``ParallelInference`` (max_batch, max_wait_ms, max_pending,
+        chaos, ...), ``fleet_kw`` to the fleet."""
+        from deeplearning4j_tpu.parallel.fleet import ReplicaFleet
+        from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+        with self._lock:
+            if isinstance(net, str):
+                mid = net
+                net = self._net(mid)
+            elif mid is None:
+                mid = f"m{self._next_id}"
+                self._next_id += 1
+            self._models[mid] = net
+        with self._inference_lock:
+            old = self._inference.pop(mid, None)
+        if old is not None:
+            old.close()
+        if int(replicas) > 1:
+            def factory(rid):
+                return ParallelInference(net, **inf_kw)
+            inf = ReplicaFleet(factory, replicas=int(replicas),
+                               **(fleet_kw or {}))
+        else:
+            inf = ParallelInference(net, **inf_kw)
+        with self._inference_lock:
+            self._inference[mid] = inf
         return mid
 
     def generate(self, mid: str, prompt_ids, max_tokens: int,
@@ -303,8 +386,14 @@ class KerasBackendServer:
         with self._lock:
             out["models"] = len(self._models)
             gens = dict(self._generators)
+        with self._inference_lock:
+            infs = dict(self._inference)
         if gens:
+            # fleet-served models carry a "replicas" list here: per-replica
+            # health score, breaker state, in-flight depth, restart count
             out["generation"] = {mid: g.stats() for mid, g in gens.items()}
+        if infs:
+            out["inference"] = {mid: i.stats() for mid, i in infs.items()}
         return out
 
     # ----------------------------------------------------------- lifecycle
@@ -412,6 +501,9 @@ class KerasBackendServer:
         with self._lock:
             gens = list(self._generators.values())
             self._generators.clear()
+        with self._inference_lock:
+            gens.extend(self._inference.values())
+            self._inference.clear()
         for g in gens:
             g.close()
         if self._httpd:

@@ -19,6 +19,7 @@ from deeplearning4j_tpu.parallel.model_sharding import (
 )
 from deeplearning4j_tpu.parallel.inference import ParallelInference
 from deeplearning4j_tpu.parallel.generation import GenerationServer
+from deeplearning4j_tpu.parallel.fleet import ReplicaFleet
 from deeplearning4j_tpu.parallel.resilience import (
     AdmissionController,
     ChaosPolicy,
@@ -26,6 +27,8 @@ from deeplearning4j_tpu.parallel.resilience import (
     CircuitOpen,
     Deadline,
     DeadlineExceeded,
+    ReplicaKilled,
+    ReplicaUnavailable,
     ResilienceError,
     RetryPolicy,
     ServerOverloaded,

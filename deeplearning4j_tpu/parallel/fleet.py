@@ -1,0 +1,736 @@
+"""ReplicaFleet: a health-routed front end over N independent model
+replicas with one ``submit() -> Future`` surface.
+
+Data-parallel *serving*, the counterpart of the training-side scale-out in
+``parallel/mesh.py``/``pipeline.py`` and the reproduction of the reference
+stack's layer-4 reason-to-exist (ParallelWrapper / parameter-server
+replicas): one sick or crashed replica sheds load into the rest of the
+fleet instead of taking the service down.
+
+Topology::
+
+    submit() -> Future
+        |
+    ReplicaFleet ------------- monitor thread (redispatch, hedging,
+        |   routing: weighted     supervised restart w/ backoff)
+        |   least-loaded over
+        |   healthy replicas
+        +-- replica 0: CircuitBreaker + AdmissionController + server
+        +-- replica 1: CircuitBreaker + AdmissionController + server
+        +-- ...            (GenerationServer or ParallelInference)
+
+Invariants:
+
+- **Zero lost futures across replica death.** Every accepted request
+  either resolves with a result or fails with a typed error from the
+  ``resilience`` taxonomy. When a replica dies mid-request (chaos kill,
+  crash, abrupt close), its in-flight and queued requests are re-submitted
+  to a surviving replica. Because generation sampling derives every
+  token's key from ``fold_in(PRNGKey(seed), token_index)`` — never from
+  server state — a re-dispatched request regenerates the *bit-exact* same
+  completion on any replica.
+- **Lock order.** Replica servers invoke our completion callbacks while
+  holding their own internal locks, so the only permitted order is
+  ``server lock -> fleet._cond``. The fleet therefore never calls into a
+  replica server (submit/drain/close/stats) while holding ``_cond``; all
+  re-dispatch, hedging, and restart work is done by the monitor thread
+  outside the lock.
+- **Typed load shedding at submit.** A fresh submit with no routable
+  replica fails fast — ``ReplicaUnavailable`` when every replica is
+  dead/restarting/draining, ``CircuitOpen`` when the survivors' breakers
+  are all open, ``ServerOverloaded`` when every replica rejected the
+  request at admission — rather than queueing behind a fleet that cannot
+  make progress. Only *accepted* work is parked for re-dispatch.
+
+Hedging (optional): when a request's newest attempt has been running
+longer than ``hedge_after_s``, the monitor launches a duplicate on a
+different healthy replica; the first result wins and the loser is
+cancelled. This bounds straggler-replica tail latency at the cost of
+duplicated work.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from deeplearning4j_tpu.parallel.resilience import (
+    AdmissionController, CircuitBreaker, CircuitOpen, Deadline,
+    DeadlineExceeded, ReplicaKilled, ReplicaUnavailable, ResilienceError,
+    ServerOverloaded)
+
+# Replica lifecycle: SPAWNING -> WARMING -> READY -> (DRAINING -> RETIRED
+# | DEAD -> SPAWNING ...). Only READY replicas take traffic; DEAD ones are
+# respawned by the monitor after their backoff; RETIRED ones never return.
+SPAWNING = "spawning"
+WARMING = "warming"
+READY = "ready"
+DRAINING = "draining"
+DEAD = "dead"
+RETIRED = "retired"
+
+_EWMA_FLOOR_MS = 0.5  # score floor so a fresh replica isn't infinitely hot
+
+
+class _Replica:
+    """Mutable per-replica record. No lock of its own — every field is
+    read and written only under the owning fleet's ``_cond`` (``server``,
+    ``rid``, ``generation``, ``breaker``, ``admission`` are written once
+    at construction and safe to read anywhere)."""
+
+    __slots__ = ("rid", "generation", "server", "breaker", "admission",
+                 "state", "inflight", "ewma_ms", "fail_ewma", "restarts",
+                 "spawn_failures", "backoff_s", "restart_at", "dispatched",
+                 "completed", "failed", "rejected", "prior_trips")
+
+    def __init__(self, rid: int, generation: int, server: Any,
+                 breaker: CircuitBreaker, admission: AdmissionController,
+                 backoff_s: float):
+        self.rid = rid
+        self.generation = generation
+        self.server = server
+        self.breaker = breaker
+        self.admission = admission
+        self.state = READY
+        self.inflight = 0
+        self.ewma_ms = 0.0
+        self.fail_ewma = 0.0
+        self.restarts = 0
+        self.spawn_failures = 0
+        self.backoff_s = backoff_s
+        self.restart_at = 0.0
+        self.dispatched = 0
+        self.completed = 0
+        self.failed = 0
+        self.rejected = 0
+        self.prior_trips = 0  # breaker trips accumulated by retired breakers
+
+
+def _score(r: _Replica) -> float:
+    """Weighted least-loaded health score — lower routes first. Queue
+    depth multiplies expected latency (EWMA); the recent-failure EWMA
+    inflates the score so a flapping replica cools off even while its
+    breaker is still closed."""
+    ewma = r.ewma_ms if r.ewma_ms > _EWMA_FLOOR_MS else _EWMA_FLOOR_MS
+    return (r.inflight + 1.0) * ewma * (1.0 + 8.0 * r.fail_ewma)
+
+
+class _FleetRequest:
+    """One accepted request: the original call (so a re-dispatch replays
+    it identically — the fold_in key schedule then makes the regenerated
+    completion bit-exact) plus routing state. Mutable fields are guarded
+    by the fleet's ``_cond``."""
+
+    __slots__ = ("args", "kwargs", "deadline", "future", "resolved",
+                 "active", "tried", "attempts", "hedges", "t_dispatch",
+                 "last_error")
+
+    def __init__(self, args: tuple, kwargs: dict,
+                 deadline: Optional[Deadline], future: Future):
+        self.args = args
+        self.kwargs = kwargs
+        self.deadline = deadline
+        self.future = future
+        self.resolved = False
+        self.active: Dict[int, Future] = {}  # rid -> in-flight inner future
+        self.tried: set = set()
+        self.attempts = 0
+        self.hedges = 0
+        self.t_dispatch = 0.0
+        self.last_error: Optional[BaseException] = None
+
+
+class ReplicaFleet:
+    """Route ``submit()`` traffic over ``replicas`` independent servers
+    built by ``factory(rid)`` — anything with the serving contract
+    ``submit(*args, deadline_s=..., **kwargs) -> Future``, ``drain``,
+    ``close``, ``stats`` (``GenerationServer`` and ``ParallelInference``
+    both qualify).
+
+    ``hedge_after_s`` enables straggler hedging; ``restart=False``
+    disables supervised restart (dead replicas stay dead); ``warmup`` is
+    an optional callable run on every freshly spawned server before it
+    takes traffic (e.g. a canary request that pre-compiles programs).
+    """
+
+    def __init__(self, factory: Callable[[int], Any], replicas: int = 2, *,
+                 max_pending: int = 256, replica_max_pending: int = 64,
+                 hedge_after_s: Optional[float] = None, max_hedges: int = 1,
+                 max_redispatch: Optional[int] = None,
+                 restart: bool = True, restart_backoff_s: float = 0.05,
+                 restart_backoff_cap_s: float = 2.0,
+                 warmup: Optional[Callable[[Any], None]] = None,
+                 breaker_factory: Optional[Callable[[], CircuitBreaker]]
+                 = None,
+                 health_alpha: float = 0.25, tick_s: float = 0.005):
+        if int(replicas) < 1:
+            raise ValueError("need at least one replica")
+        self._factory = factory
+        self._warmup = warmup
+        self._breaker_factory = breaker_factory
+        self._replica_max_pending = int(replica_max_pending)
+        self._hedge_after_s = (None if hedge_after_s is None
+                               else float(hedge_after_s))
+        self._max_hedges = max(0, int(max_hedges))
+        self._max_redispatch = (None if max_redispatch is None
+                                else int(max_redispatch))
+        self._restart = bool(restart)
+        self._restart_backoff_s = float(restart_backoff_s)
+        self._restart_backoff_cap_s = float(restart_backoff_cap_s)
+        self._alpha = float(health_alpha)
+        self._tick_s = float(tick_s)
+        self.admission = AdmissionController(max_pending=max_pending)
+
+        self._cond = threading.Condition()
+        self._pending: deque = deque()   # parked _FleetRequests (redispatch)
+        self._inflight_reqs: set = set()  # every unresolved _FleetRequest
+        self._replicas: List[_Replica] = []
+        self._closing = False
+        self._stop = False
+        self._submitted = 0
+        self._rejected_submits = 0
+        self._completed = 0
+        self._failed = 0
+        self._expired = 0
+        self._redispatched = 0
+        self._hedged = 0
+        self._losers_cancelled = 0
+        self._deaths = 0
+        self._restarts = 0
+
+        for rid in range(int(replicas)):
+            server = factory(rid)  # spawn errors propagate at construction
+            if warmup is not None:
+                warmup(server)
+            self._replicas.append(self._new_replica(rid, 0, server))
+
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         name="fleet-monitor", daemon=True)
+        self._monitor.start()
+
+    # -- construction helpers ------------------------------------------
+
+    def _new_replica(self, rid: int, generation: int,
+                     server: Any) -> _Replica:
+        if self._breaker_factory is not None:
+            breaker = self._breaker_factory()
+        else:
+            breaker = CircuitBreaker(failure_threshold=0.5, window=16,
+                                     min_calls=6, reset_timeout_s=0.25)
+        admission = AdmissionController(
+            max_pending=self._replica_max_pending)
+        return _Replica(rid, generation, server, breaker, admission,
+                        self._restart_backoff_s)
+
+    # -- public surface ------------------------------------------------
+
+    def __enter__(self) -> "ReplicaFleet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    @property
+    def replica_count(self) -> int:
+        with self._cond:
+            return len(self._replicas)
+
+    def submit(self, *args, deadline_s: Optional[float] = None,
+               **kwargs) -> Future:
+        """Route one request to the healthiest replica. Returns a Future
+        that resolves with the replica's result, survives replica death
+        via re-dispatch, and fails only with a typed error. Raises
+        ``ServerOverloaded`` / ``CircuitOpen`` / ``ReplicaUnavailable``
+        synchronously when the fleet cannot accept the request."""
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError("deadline_s must be positive")
+        with self._cond:
+            if self._closing:
+                raise RuntimeError("ReplicaFleet is closed")
+        self.admission.acquire()  # fleet-wide high-watermark (429)
+        fut = Future()
+        fut.add_done_callback(lambda _f: self.admission.release())
+        freq = _FleetRequest(
+            args, kwargs,
+            None if deadline_s is None else Deadline(deadline_s), fut)
+        with self._cond:
+            self._submitted += 1
+            self._inflight_reqs.add(freq)
+        try:
+            routed, reason = self._route_once(freq)
+        except ValueError:
+            # caller error (bad prompt/shape): fail sync, like the servers
+            self._resolve(freq, None, None)  # unlink + release admission
+            raise
+        if routed:
+            return fut
+        if reason == "breaker":
+            exc: Exception = CircuitOpen(
+                "every healthy replica's circuit breaker is open")
+        elif reason == "rejected" and isinstance(freq.last_error,
+                                                 ResilienceError):
+            exc = freq.last_error
+        else:
+            exc = ReplicaUnavailable(
+                "no replica can accept the request (all dead, draining, "
+                "or restarting)")
+        self._resolve(freq, None, exc, rejected=True)
+        raise exc
+
+    def kill_replica(self, rid: int) -> bool:
+        """Abruptly kill one replica (ops drill / chaos hook): its server
+        is closed with a zero drain budget, every request it held fails
+        typed and re-dispatches to the survivors, and the monitor respawns
+        it after the restart backoff."""
+        with self._cond:
+            rep = self._replicas[rid]
+            if rep.state in (DEAD, RETIRED):
+                return False
+            rep.state = DEAD
+            rep.restart_at = time.monotonic() + rep.backoff_s
+            self._deaths += 1
+            server = rep.server
+            self._cond.notify_all()
+        try:
+            server.close(timeout=0.0)
+        except Exception:
+            pass
+        return True
+
+    def retire_replica(self, rid: int,
+                       timeout: Optional[float] = 30.0) -> bool:
+        """Gracefully drain one replica and take it out of the fleet for
+        good (scale-down). Returns False if it was not READY."""
+        with self._cond:
+            rep = self._replicas[rid]
+            if rep.state != READY:
+                return False
+            rep.state = DRAINING
+            server = rep.server
+        try:
+            server.drain(timeout)
+            server.close(timeout=5.0)
+        except Exception:
+            pass
+        with self._cond:
+            if self._replicas[rid] is rep:
+                rep.state = RETIRED
+            self._cond.notify_all()
+        return True
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until every accepted request has resolved (or the
+        timeout passes). New submits are still accepted while draining —
+        pair with ``close()`` for shutdown."""
+        dl = None if timeout is None else Deadline(timeout)
+        with self._cond:
+            while self._inflight_reqs or self._pending:
+                if dl is not None and dl.expired():
+                    return False
+                wait_s = self._tick_s * 10.0
+                if dl is not None:
+                    rem = dl.remaining()
+                    if rem < wait_s:
+                        wait_s = rem if rem > 0.001 else 0.001
+                self._cond.wait(timeout=wait_s)
+        return True
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Stop accepting work, give in-flight requests ``timeout``
+        seconds to finish (re-dispatch keeps running), then stop the
+        monitor, close every replica, and fail any stragglers typed.
+        Idempotent."""
+        with self._cond:
+            already = self._stop
+            self._closing = True
+            self._cond.notify_all()
+        if not already:
+            self.drain(timeout)
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+            reps = list(self._replicas)
+        if self._monitor.is_alive():
+            self._monitor.join(timeout=5.0)
+        for rep in reps:
+            try:
+                rep.server.close(timeout=1.0)
+            except Exception:
+                pass
+        with self._cond:
+            for rep in reps:
+                if rep.state not in (RETIRED,):
+                    rep.state = RETIRED
+            leftovers = list(self._inflight_reqs)
+            leftovers.extend(self._pending)
+            self._pending.clear()
+        closed_exc = RuntimeError(
+            "ReplicaFleet closed with the request still in flight")
+        for freq in leftovers:
+            self._resolve(freq, None, closed_exc)
+
+    def stats(self) -> dict:
+        with self._cond:
+            reps = list(self._replicas)
+            out = {
+                "replica_count": len(reps),
+                "submitted": self._submitted,
+                "rejected_submits": self._rejected_submits,
+                "completed": self._completed,
+                "failed": self._failed,
+                "expired": self._expired,
+                "redispatched": self._redispatched,
+                "hedged": self._hedged,
+                "losers_cancelled": self._losers_cancelled,
+                "deaths": self._deaths,
+                "restarts": self._restarts,
+                "parked": len(self._pending),
+                "inflight": len(self._inflight_reqs),
+            }
+            per = []
+            for r in reps:
+                per.append({
+                    "rid": r.rid,
+                    "state": r.state,
+                    "generation": r.generation,
+                    "health_score": _score(r),
+                    "ewma_latency_ms": r.ewma_ms,
+                    "failure_ewma": r.fail_ewma,
+                    "inflight": r.inflight,
+                    "restarts": r.restarts,
+                    "spawn_failures": r.spawn_failures,
+                    "dispatched": r.dispatched,
+                    "completed": r.completed,
+                    "failed": r.failed,
+                    "rejected": r.rejected,
+                })
+        # server/breaker/admission calls take their own locks: keep them
+        # outside _cond (replica callbacks already hold server locks when
+        # they take _cond, so the reverse order would be a lock cycle)
+        for blk, r in zip(per, reps):
+            blk["breaker"] = r.breaker.state
+            blk["breaker_trips"] = r.prior_trips + r.breaker.open_count
+            blk["admission"] = {"pending": r.admission.pending,
+                                "accepted": r.admission.accepted,
+                                "rejected": r.admission.rejected}
+            try:
+                blk["server"] = r.server.stats()
+            except Exception:
+                blk["server"] = None
+        out["admission"] = {"pending": self.admission.pending,
+                            "accepted": self.admission.accepted,
+                            "rejected": self.admission.rejected,
+                            "max_pending": self.admission.max_pending}
+        out["replicas"] = per
+        return out
+
+    # -- routing core (hot path) ---------------------------------------
+
+    def _route_once(self, freq: _FleetRequest,
+                    hedge: bool = False) -> Tuple[bool, str]:
+        """Try to dispatch ``freq`` to the best replica right now.
+        Returns ``(True, "dispatched")`` when an attempt is in flight (or
+        the request resolved), else ``(False, reason)`` with reason one of
+        ``"noreplica"`` (nothing READY), ``"breaker"`` (READY but every
+        breaker open), ``"rejected"`` (every candidate refused at
+        admission/submit). ValueError from the server (caller error)
+        propagates. Never called with ``_cond`` held."""
+        skip: set = set()
+        saw_breaker_block = False
+        saw_rejection = False
+        while True:
+            with self._cond:
+                if freq.resolved or freq.future.cancelled():
+                    return True, "dispatched"
+                if freq.deadline is not None and freq.deadline.expired():
+                    expired = True
+                else:
+                    expired = False
+                rep = None
+                if not expired:
+                    cands = [r for r in self._replicas
+                             if r.state == READY and r.rid not in skip
+                             and r.rid not in freq.active]
+                    if cands:
+                        fresh = [r for r in cands
+                                 if r.rid not in freq.tried]
+                        pool = fresh if fresh else cands
+                        best = min(pool, key=_score)
+                        if best.breaker.allow():
+                            best.inflight += 1
+                            best.dispatched += 1
+                            rep = best
+                        else:
+                            saw_breaker_block = True
+                            skip.add(best.rid)
+                            continue
+                rem = None
+                if rep is not None and freq.deadline is not None:
+                    rem = freq.deadline.remaining()
+                    if rem < 0.001:
+                        rem = 0.001
+            if expired:
+                self._resolve(freq, None, DeadlineExceeded(
+                    "request deadline expired before dispatch"))
+                return True, "dispatched"
+            if rep is None:
+                if saw_rejection:
+                    return False, "rejected"
+                if saw_breaker_block:
+                    return False, "breaker"
+                return False, "noreplica"
+            # outside _cond from here: per-replica admission + dispatch
+            try:
+                rep.admission.acquire()
+            except ServerOverloaded as e:
+                with self._cond:
+                    rep.inflight -= 1
+                    rep.rejected += 1
+                    freq.last_error = e
+                saw_rejection = True
+                skip.add(rep.rid)
+                continue
+            t0 = time.monotonic()
+            try:
+                kwargs = freq.kwargs
+                if freq.deadline is not None:
+                    kwargs = dict(kwargs)
+                    kwargs["deadline_s"] = rem
+                inner = rep.server.submit(*freq.args, **kwargs)
+            except ValueError:
+                rep.admission.release()
+                with self._cond:
+                    rep.inflight -= 1
+                raise
+            except Exception as e:
+                rep.admission.release()
+                with self._cond:
+                    rep.inflight -= 1
+                    rep.rejected += 1
+                    rep.fail_ewma = ((1.0 - self._alpha) * rep.fail_ewma
+                                     + self._alpha)
+                    freq.last_error = e
+                rep.breaker.record_failure()
+                saw_rejection = True
+                skip.add(rep.rid)
+                continue
+            with self._cond:
+                freq.tried.add(rep.rid)
+                freq.attempts += 1
+                freq.active[rep.rid] = inner
+                freq.t_dispatch = t0
+                if hedge:
+                    freq.hedges += 1
+                    self._hedged += 1
+            # if `inner` is already done this fires the callback inline
+            inner.add_done_callback(
+                functools.partial(self._replica_done, freq, rep, t0))
+            return True, "dispatched"
+
+    def _replica_done(self, freq: _FleetRequest, rep: _Replica, t0: float,
+                      fut: Future) -> None:
+        """Completion arbiter for one replica attempt. May run inline on
+        the replica server's own threads *while that server holds its
+        internal lock* — so this takes only ``_cond`` and never calls
+        back into any replica server."""
+        lat_ms = (time.monotonic() - t0) * 1000.0
+        cancelled = fut.cancelled()
+        exc = None if cancelled else fut.exception()
+        died = isinstance(exc, ReplicaKilled)
+        with self._cond:
+            current = self._replicas[rep.rid] is rep
+            rep.inflight -= 1
+            if cancelled:
+                pass
+            elif exc is None:
+                rep.completed += 1
+                if rep.ewma_ms == 0.0:
+                    rep.ewma_ms = lat_ms
+                else:
+                    rep.ewma_ms = ((1.0 - self._alpha) * rep.ewma_ms
+                                   + self._alpha * lat_ms)
+                rep.fail_ewma = (1.0 - self._alpha) * rep.fail_ewma
+            else:
+                rep.failed += 1
+                rep.fail_ewma = ((1.0 - self._alpha) * rep.fail_ewma
+                                 + self._alpha)
+            if died and current and rep.state == READY:
+                rep.state = DEAD
+                rep.restart_at = time.monotonic() + rep.backoff_s
+                self._deaths += 1
+            freq.active.pop(rep.rid, None)
+            has_twin = len(freq.active) > 0
+            is_resolved = freq.resolved
+            stopping = self._stop
+            self._cond.notify_all()
+        rep.admission.release()
+        if cancelled:
+            return
+        if exc is None:
+            rep.breaker.record_success()
+            self._resolve(freq, fut.result(), None)
+            return
+        rep.breaker.record_failure()
+        if is_resolved:
+            return
+        if isinstance(exc, DeadlineExceeded):
+            # the budget is global: a hedge twin cannot beat it either
+            self._resolve(freq, None, exc)
+            return
+        if has_twin:
+            return  # the hedge twin is still running and may win
+        if stopping:
+            self._resolve(freq, None, exc)
+            return
+        if freq.deadline is not None and freq.deadline.expired():
+            self._resolve(freq, None, DeadlineExceeded(
+                "request deadline expired during replica failover"))
+            return
+        if (self._max_redispatch is not None
+                and freq.attempts > self._max_redispatch):
+            self._resolve(freq, None, exc)
+            return
+        with self._cond:
+            if not freq.resolved and not self._stop:
+                self._pending.append(freq)
+                self._redispatched += 1
+                self._cond.notify_all()
+                return
+        self._resolve(freq, None, exc)
+
+    def _resolve(self, freq: _FleetRequest, value: Any,
+                 exc: Optional[BaseException], *,
+                 rejected: bool = False) -> bool:
+        """Resolve the caller-facing future exactly once (first caller
+        wins) and cancel any still-running duplicate attempts. Submit-time
+        rejections (``rejected=True``: typed shed re-raised to the caller,
+        or ``exc is None and value is None`` for ValueError unlinks) count
+        as ``rejected_submits`` rather than ``failed`` — the request was
+        never accepted, so ``submitted == completed + failed + expired +
+        rejected_submits`` once the fleet is idle."""
+        with self._cond:
+            if freq.resolved:
+                return False
+            freq.resolved = True
+            self._inflight_reqs.discard(freq)
+            losers = list(freq.active.values())
+            self._losers_cancelled += len(losers)
+            if rejected or (exc is None and value is None):
+                self._rejected_submits += 1
+            elif exc is None:
+                self._completed += 1
+            elif isinstance(exc, DeadlineExceeded):
+                self._expired += 1
+            else:
+                self._failed += 1
+            self._cond.notify_all()
+        for loser in losers:
+            loser.cancel()  # queued attempts die; running ones are ignored
+        try:
+            if exc is None:
+                freq.future.set_result(value)
+            else:
+                freq.future.set_exception(exc)
+        except Exception:
+            pass  # caller cancelled the fleet future: outcome dropped
+        return True
+
+    # -- monitor: redispatch, hedging, supervised restart --------------
+
+    def _monitor_loop(self) -> None:
+        while True:
+            with self._cond:
+                if self._stop:
+                    return
+                self._cond.wait(timeout=self._tick_s)
+                if self._stop:
+                    return
+                now = time.monotonic()
+                work = []
+                while self._pending:
+                    work.append(self._pending.popleft())
+                spawn = []
+                if self._restart:
+                    for r in self._replicas:
+                        if r.state == DEAD and r.restart_at <= now:
+                            r.state = SPAWNING
+                            spawn.append(r.rid)
+                hedges = []
+                if self._hedge_after_s is not None:
+                    for freq in self._inflight_reqs:
+                        if (not freq.resolved
+                                and len(freq.active) == 1
+                                and freq.hedges < self._max_hedges
+                                and now - freq.t_dispatch
+                                >= self._hedge_after_s):
+                            hedges.append(freq)
+            for rid in spawn:
+                self._respawn(rid)
+            for freq in work:
+                self._service_parked(freq)
+            for freq in hedges:
+                try:
+                    self._route_once(freq, hedge=True)
+                except ValueError:
+                    pass  # original attempt is still running; let it win
+
+    def _service_parked(self, freq: _FleetRequest) -> None:
+        try:
+            routed, _reason = self._route_once(freq)
+        except ValueError as e:
+            self._resolve(freq, None, e)
+            return
+        if routed:
+            return
+        with self._cond:
+            if not freq.resolved and not self._stop:
+                self._pending.append(freq)  # retry next tick
+                return
+        self._resolve(freq, None, RuntimeError(
+            "ReplicaFleet stopped with the request still queued"))
+
+    def _respawn(self, rid: int) -> None:
+        """Supervised restart of a dead replica (monitor thread only):
+        close the corpse, rebuild via the factory, warm it, and swap it in
+        with a fresh breaker. Spawn failures back off exponentially."""
+        with self._cond:
+            rep = self._replicas[rid]
+            old_server = rep.server
+        try:
+            old_server.close(timeout=0.0)
+        except Exception:
+            pass
+        try:
+            server = self._factory(rid)
+            if self._warmup is not None:
+                with self._cond:
+                    rep.state = WARMING
+                self._warmup(server)
+        except Exception:
+            with self._cond:
+                rep.state = DEAD
+                rep.spawn_failures += 1
+                rep.backoff_s = min(rep.backoff_s * 2.0,
+                                    self._restart_backoff_cap_s)
+                rep.restart_at = time.monotonic() + rep.backoff_s
+            return
+        fresh = self._new_replica(rid, 0, server)
+        with self._cond:
+            old = self._replicas[rid]
+            fresh.generation = old.generation + 1
+            fresh.restarts = old.restarts + 1
+            fresh.spawn_failures = old.spawn_failures
+            fresh.prior_trips = old.prior_trips + old.breaker.open_count
+            # traffic counters are cumulative per replica *slot*: a restart
+            # replaces the server, not the slot's ops history
+            fresh.dispatched = old.dispatched
+            fresh.completed = old.completed
+            fresh.failed = old.failed
+            fresh.rejected = old.rejected
+            self._replicas[rid] = fresh
+            self._restarts += 1
+            self._cond.notify_all()

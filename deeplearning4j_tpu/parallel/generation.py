@@ -297,6 +297,7 @@ class GenerationServer:
         self._completed = 0
         self._failed = 0
         self._retried = 0
+        self._pool_rebuilds = 0
         self._prefills = 0
         self._decode_steps = 0
         self._tokens = 0
@@ -1407,7 +1408,11 @@ class GenerationServer:
     def _fail_all(self, exc: BaseException):
         """Hard dispatch fault: every in-flight request fails typed
         (never hangs) and the page pool + device carries are rebuilt
-        from zeros."""
+        from zeros. The rebuild decision is taken under ``_cond`` so a
+        chaos kill racing ``close()``/``drain()`` cannot resurrect device
+        state on a server that is already shutting down — after the
+        victims fail there is nothing left to serve, so a closing server
+        skips the rebuild entirely (idempotent with close)."""
         with self._cond:
             victims = [r for r in self._slot_req if r is not None]
             victims += list(self._queue)
@@ -1415,10 +1420,14 @@ class GenerationServer:
             self._slot_req = [None] * self.slots
             self._n_active = 0
             self._failed += len(victims)
+            rebuild = not (self._closing or self._stop)
+            if rebuild:
+                self._pool_rebuilds += 1
             self._cond.notify_all()
         for req in victims:
             self._fail(req, exc)
-        self._reset_device_state()
+        if rebuild:
+            self._reset_device_state()
 
     def _reset_device_state(self):
         self._page_pool = _PagePool(self.pages_total)
@@ -1493,6 +1502,7 @@ class GenerationServer:
                 "completed": self._completed,
                 "failed": self._failed,
                 "retried": self._retried,
+                "pool_rebuilds": self._pool_rebuilds,
                 "prefills": self._prefills,
                 "decode_steps": self._decode_steps,
                 "tokens_generated": self._tokens,

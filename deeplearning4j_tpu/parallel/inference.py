@@ -134,8 +134,15 @@ class ParallelInference:
 
     # ----------------------------------------------------------- jit cache
     def _get_fwd(self, shape, has_mask):
-        key = (shape, has_mask)
-        if key not in self._fwd_cache:
+        """Compiled sharded forward for one (bucket shape, mask) pair.
+
+        The program lives in the *net's* bucketed output cache, not this
+        instance, so every ``ParallelInference`` over the same net — and
+        in particular a supervised fleet restart that rebuilds the replica
+        from the same net — reuses the already-compiled programs instead
+        of paying a cold recompile on its first request."""
+
+        def build():
             net = self.net
             batch_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
             replicated = NamedSharding(self.mesh, P())
@@ -152,11 +159,19 @@ class ParallelInference:
                                                     rng=None)
                     return outs[0]
 
-            self._fwd_cache[key] = jax.jit(
+            return jax.jit(
                 fwd,
                 in_shardings=(replicated, replicated, batch_sharding,
                               batch_sharding if has_mask else None),
                 out_shardings=batch_sharding)
+
+        if hasattr(self.net, "_get_output"):
+            devs = tuple(d.id for d in self.mesh.devices.flat)
+            return self.net._get_output(("pi_fwd", shape, has_mask, devs),
+                                        build)
+        key = (shape, has_mask)  # net without a bucketed cache: keep local
+        if key not in self._fwd_cache:
+            self._fwd_cache[key] = build()
         return self._fwd_cache[key]
 
     def _dispatch_fwd(self, x, mask):

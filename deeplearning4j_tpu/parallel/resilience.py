@@ -67,6 +67,21 @@ class TransientDispatchError(ResilienceError):
     retry budget is exhausted)."""
 
 
+class ReplicaKilled(ResilienceError):
+    """A model replica died mid-request (process crash, device loss, or an
+    injected chaos kill). Non-retryable at the replica level — the replica
+    is gone — but the fleet router re-dispatches the victim requests to a
+    surviving replica, so callers behind a ``ReplicaFleet`` normally never
+    see this. HTTP mapping: 503 (when it does escape)."""
+
+
+class ReplicaUnavailable(ResilienceError):
+    """No replica can take the request right now: every fleet member is
+    dead, restarting, draining, or breaker-open. Raised at submit so the
+    caller sheds load instead of queueing behind a fleet that cannot make
+    progress. HTTP mapping: 503."""
+
+
 class Deadline:
     """Per-request time budget with remaining-time propagation.
 
@@ -273,22 +288,51 @@ class ChaosPolicy:
     (retryable), and hard errors, at independent per-call rates drawn from
     one seeded rng. All rates default to 0 and nothing in the production
     path constructs one: chaos only exists where a test or bench passes it
-    in explicitly."""
+    in explicitly.
+
+    Replica-targeted fault modes (for ``ReplicaFleet`` drills; give each
+    replica its own policy with a distinct seed to target them
+    independently):
+
+    - ``kill_rate``: raise ``ReplicaKilled`` — a hard, non-retryable
+      replica death. Inside a ``GenerationServer`` dispatch this takes the
+      hard-fault path (every in-flight request on the replica fails typed),
+      which is exactly the signal the fleet treats as replica death.
+    - ``stall_rate``/``stall_s``: the dispatch freezes for ``stall_s``
+      before running — a straggler replica, the hedging target.
+    - ``slow_rate``/``slow_factor``: the dispatch runs, then the wrapper
+      sleeps ``(slow_factor - 1) x`` the measured run time — slow-decode,
+      degrading throughput without ever failing.
+
+    The replica-mode randoms are drawn only when one of the replica rates
+    is non-zero, so pre-existing seeds reproduce the same latency/error
+    sequences as before."""
 
     def __init__(self, seed: int = 0, transient_rate: float = 0.0,
                  hard_rate: float = 0.0, latency_s: float = 0.0,
                  latency_rate: float = 0.0,
+                 kill_rate: float = 0.0,
+                 stall_rate: float = 0.0, stall_s: float = 0.0,
+                 slow_rate: float = 0.0, slow_factor: float = 1.0,
                  sleep: Callable[[float], None] = time.sleep):
         self.transient_rate = float(transient_rate)
         self.hard_rate = float(hard_rate)
         self.latency_s = float(latency_s)
         self.latency_rate = float(latency_rate)
+        self.kill_rate = float(kill_rate)
+        self.stall_rate = float(stall_rate)
+        self.stall_s = float(stall_s)
+        self.slow_rate = float(slow_rate)
+        self.slow_factor = float(slow_factor)
         self._sleep = sleep
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self.injected_transient = 0
         self.injected_hard = 0
         self.injected_latency = 0
+        self.injected_kill = 0
+        self.injected_stall = 0
+        self.injected_slow = 0
 
     def wrap(self, fn: Callable) -> Callable:
         """The chaotic twin of ``fn``: same signature, same result, but
@@ -304,19 +348,49 @@ class ChaosPolicy:
                 inject_transient = (self.transient_rate and not inject_hard
                                     and r_error < (self.hard_rate
                                                    + self.transient_rate))
+                inject_kill = inject_stall = inject_slow = False
+                if self.kill_rate or self.stall_rate or self.slow_rate:
+                    # stacked thresholds on one extra draw: at most one
+                    # replica-targeted fault per call, mutually exclusive
+                    r_rep = self._rng.random()
+                    inject_kill = r_rep < self.kill_rate
+                    inject_stall = (not inject_kill
+                                    and r_rep < (self.kill_rate
+                                                 + self.stall_rate))
+                    inject_slow = (not (inject_kill or inject_stall)
+                                   and r_rep < (self.kill_rate
+                                                + self.stall_rate
+                                                + self.slow_rate))
                 if inject_latency:
                     self.injected_latency += 1
                 if inject_hard:
                     self.injected_hard += 1
                 if inject_transient:
                     self.injected_transient += 1
+                if inject_kill:
+                    self.injected_kill += 1
+                if inject_stall:
+                    self.injected_stall += 1
+                if inject_slow:
+                    self.injected_slow += 1
             if inject_latency:
                 self._sleep(self.latency_s)
+            if inject_stall:
+                self._sleep(self.stall_s)
+            if inject_kill:
+                raise ReplicaKilled("chaos: replica killed")
             if inject_hard:
                 raise RuntimeError("chaos: injected hard fault")
             if inject_transient:
                 raise TransientDispatchError("chaos: injected transient "
                                              "fault")
+            if inject_slow:
+                t0 = time.monotonic()
+                out = fn(*args, **kwargs)
+                if self.slow_factor > 1.0:
+                    self._sleep((self.slow_factor - 1.0)
+                                * (time.monotonic() - t0))
+                return out
             return fn(*args, **kwargs)
 
         return chaotic

@@ -142,6 +142,11 @@ def pytest_configure(config):
         "set)")
     config.addinivalue_line(
         "markers",
+        "fleet: replica-fleet serving tests (health routing, failover "
+        "redispatch, supervised restart, hedging, chaos soak — CPU-fast; "
+        "runs in tier-1, deliberately NOT in the slow set)")
+    config.addinivalue_line(
+        "markers",
         "allow_step_recompiles: opt out of the per-test train-step "
         "recompile-count guard")
     config.addinivalue_line(
@@ -164,7 +169,8 @@ def _lock_order_debug(request):
     in production."""
     if os.environ.get("DL4J_TPU_LOCK_DEBUG") != "1" or not (
             request.node.get_closest_marker("serving")
-            or request.node.get_closest_marker("generation")):
+            or request.node.get_closest_marker("generation")
+            or request.node.get_closest_marker("fleet")):
         yield
         return
     from deeplearning4j_tpu.analysis import instrument

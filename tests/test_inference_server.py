@@ -202,7 +202,22 @@ class TestBucketedCache:
         for n in range(1, 10):
             np.testing.assert_allclose(inf.output(x[:n]), full[:n],
                                        rtol=1e-5, atol=1e-6)
-        assert len(inf._fwd_cache) <= 2
+        programs = [k for k in net._output_cache if k[0] == "pi_fwd"]
+        assert len(programs) <= 2
+
+    def test_fwd_programs_shared_across_instances(self):
+        """A rebuilt server over the same net (the fleet's supervised
+        restart) reuses the net-level compiled programs: no new cache
+        entries on the second instance's dispatches."""
+        net = _mln()
+        x = _features(8, seed=8)
+        inf1 = ParallelInference(net, workers=8)
+        ref = inf1.output(x)
+        n_programs = len(net._output_cache)
+        inf2 = ParallelInference(net, workers=8)
+        np.testing.assert_allclose(inf2.output(x), ref, rtol=1e-5,
+                                   atol=1e-6)
+        assert len(net._output_cache) == n_programs
 
 
 @pytest.mark.serving
