@@ -476,6 +476,22 @@ def bench_eval_e2e(batch: int = 1, n_examples: int = 96, reps: int = 5):
     }
 
 
+def _serve_latency_quantiles(lat_ms, prefix):
+    """p50/p99 over a latency sample via the metrics histogram — the
+    registry's nearest-rank quantile is the single percentile
+    implementation for bench AND serving. (The inline index math it
+    replaces, ``lat_ms[int(len(lat_ms) * 0.99)]``, read one rank past
+    the nearest-rank p99 at these sample counts — and past the END of
+    the list whenever the count is a multiple of 100.)"""
+    from deeplearning4j_tpu.metrics.registry import Histogram
+
+    h = Histogram(reservoir=max(1, len(lat_ms)))
+    for v in lat_ms:
+        h.observe(v)
+    return {f"{prefix}_p50_ms": h.quantile(0.5),
+            f"{prefix}_p99_ms": h.quantile(0.99)}
+
+
 def bench_inference_serve(n_requests: int = 256, max_batch: int = 64,
                           max_wait_ms: float = 2.0):
     """Coalescing inference server latency/throughput: ``n_requests``
@@ -518,8 +534,7 @@ def bench_inference_serve(n_requests: int = 256, max_batch: int = 64,
     return {
         "inference_serve_req_s": _sane("inference_serve_req_s",
                                        n_requests / total),
-        "inference_serve_p50_ms": lat_ms[len(lat_ms) // 2],
-        "inference_serve_p99_ms": lat_ms[int(len(lat_ms) * 0.99)],
+        **_serve_latency_quantiles(lat_ms, "inference_serve"),
         "inference_serve_dispatches": float(dispatches),
     }
 
@@ -584,8 +599,7 @@ def bench_serve_chaos(n_requests: int = 256, max_batch: int = 64,
     return {
         "serve_chaos_req_s": _sane("serve_chaos_req_s",
                                    n_requests / total),
-        "serve_chaos_p50_ms": lat_ms[len(lat_ms) // 2],
-        "serve_chaos_p99_ms": lat_ms[int(len(lat_ms) * 0.99)],
+        **_serve_latency_quantiles(lat_ms, "serve_chaos"),
         "serve_chaos_typed_failure_frac": failed_typed / n_requests,
         "serve_chaos_retries": float(st["retried"]),
         "serve_chaos_injected_faults": float(chaos.injected_transient),
@@ -747,8 +761,7 @@ def bench_serve_fleet(n_requests: int = 96, repeats: int = 3,
         "serve_fleet_1rep_req_s": _sane("serve_fleet_1rep_req_s",
                                         req_s_1),
         "serve_fleet_scaling": scaling,
-        "serve_fleet_p50_ms": lat_ms[len(lat_ms) // 2],
-        "serve_fleet_p99_ms": lat_ms[int(len(lat_ms) * 0.99)],
+        **_serve_latency_quantiles(lat_ms, "serve_fleet"),
         "serve_fleet_deaths": float(st2["deaths"]),
         "serve_fleet_restarts": float(st2["restarts"]),
         "serve_fleet_redispatched": float(st2["redispatched"]),
@@ -859,8 +872,7 @@ def bench_generate_serve(n_requests: int = 64, slots: int = 64,
         "generate_serve_serial_tokens_s": _sane(
             "generate_serve_serial_tokens_s", n_tokens / serial_s),
         "generate_serve_speedup": speedup,
-        "generate_serve_p50_ms": lat_ms[len(lat_ms) // 2],
-        "generate_serve_p99_ms": lat_ms[int(len(lat_ms) * 0.99)],
+        **_serve_latency_quantiles(lat_ms, "generate_serve"),
     }
 
 
@@ -949,6 +961,214 @@ def bench_generate_longtail(slots: int = 8, vocab: int = 256,
         "generate_longtail_cow_copies": float(
             st["pages"]["cow_copies"]),
     }
+
+
+def bench_serve_soak(duration_s: float = 8.0, lo: float = 1200.0,
+                     hi: float = 1550.0, ramp_s: float = 3.0,
+                     spike_add: float = 500.0, spike_at: float = 4.5,
+                     spike_dur: float = 1.0, max_batch: int = 128,
+                     slo_p99_ms: float = 1500.0,
+                     min_req_s: float = 1400.0, seed: int = 0):
+    """Closed-loop soak of the coalescing inference path under a seeded
+    open-arrival load: a non-homogeneous Poisson process (linear ramp
+    ``lo``->``hi`` req/s with a rectangular spike riding on top) drives
+    single-image LeNet requests through ``ParallelInference`` while a
+    queue-driven ``Autoscaler`` grows/shrinks the coalescer pool from
+    observed backlog. Latency is measured from the SCHEDULED arrival
+    (no coordinated omission: a stalled server inflates the tail, it
+    cannot pace the generator down).
+
+    This is an SLO gate, not just a throughput read — the bench RAISES
+    unless all of: p99 under ``slo_p99_ms``, zero lost futures
+    (submitted == completed + failed, the ledger the whole serving
+    stack promises), zero failed at this admission headroom, and
+    sustained throughput >= ``min_req_s``.
+
+    Floor calibration: a bare submit loop saturates this coalescer at
+    ~2300 single-row req/s, but that number has no pacing, no per-
+    request latency capture, and no ledger — the honest end-to-end
+    ceiling THROUGH the generator (scheduled sleeps, submit/record
+    bookkeeping, registry publication, all GIL-serialized against the
+    serving threads) measures 1700-2050 req/s across runs on this
+    shared box, flat across 1-4 coalescers (host-bound, not device-
+    bound). The offered profile averages ~1550 — under the noisy ceiling's
+    LOW end, with ~10% further headroom — so the gate measures the
+    serving path rather than the box's contention-of-the-minute,
+    the spike still drives a real backlog through the autoscaler,
+    and the floor sits ~10% under the offered average: box noise does not flake the gate, while a
+    per-request regression in the submit/publication hot path still
+    trips it. Deterministic under ``seed``: same arrival schedule,
+    same request indices."""
+    from deeplearning4j_tpu.metrics.autoscale import (Autoscaler,
+                                                      CoalescerTarget)
+    from deeplearning4j_tpu.metrics.loadgen import (LoadGenerator,
+                                                    ramp_profile,
+                                                    spike_profile)
+    from deeplearning4j_tpu.metrics.registry import MetricsRegistry
+    from deeplearning4j_tpu.models import LeNet
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+    rs = np.random.RandomState(3)
+    xs = rs.randn(64, 1, 28, 28, 1).astype(np.float32)
+    net = LeNet(num_labels=10).init()
+    registry = MetricsRegistry()
+    base = ramp_profile(lo, hi, ramp_s)
+    burst = spike_profile(0.0, spike_add, spike_at, spike_dur)
+    with ParallelInference(net, max_batch=max_batch, max_wait_ms=2.0,
+                           max_pending=65536,
+                           registry=registry) as inf:
+        # warm every pow-2 coalescer bucket: a mid-soak XLA compile
+        # would be a fake tail-latency event
+        inf.submit(xs[0]).result(timeout=120)
+        b = 2
+        while b <= max_batch:
+            inf.output(np.repeat(xs[0], b, axis=0))
+            b *= 2
+        lg = LoadGenerator(lambda i: inf.submit(xs[i % len(xs)]),
+                           seed=seed, registry=registry)
+        scaler = Autoscaler([CoalescerTarget(inf)], high_depth=64,
+                            low_depth=8, up_ticks=2, down_ticks=10,
+                            cooldown_s=1.0, registry=registry)
+        scaler.start(interval_s=0.2)
+        try:
+            res = lg.run_open(lambda t: base(t) + burst(t), duration_s,
+                              rate_max=hi + spike_add,
+                              timeout_s=SUB_BENCH_TIMEOUT_S)
+        finally:
+            scaler.stop()
+        st = inf.stats()
+    if res.lost:  # the zero-lost-futures ledger is the point
+        raise RuntimeError(
+            f"soak leaked {res.lost} futures (submitted "
+            f"{res.submitted}, completed {res.completed}, failed "
+            f"{res.failed})")
+    if res.failed:
+        raise RuntimeError(
+            f"{res.failed} soak requests failed typed ({res.errors}) "
+            "despite admission headroom — serving regression")
+    if st["completed"] < res.completed:
+        raise RuntimeError(
+            "registry ledger disagrees with the load generator: "
+            f"inference completed {st['completed']} < soak completed "
+            f"{res.completed}")
+    p50 = res.quantile(0.5)
+    p99 = res.quantile(0.99)
+    if not p99 < slo_p99_ms:
+        raise RuntimeError(
+            f"soak p99 {p99:.1f} ms breaches the {slo_p99_ms:.0f} ms "
+            "SLO — backlog never drained")
+    if res.achieved_req_s < min_req_s:
+        raise RuntimeError(
+            f"soak sustained {res.achieved_req_s:.0f} req/s — below "
+            f"the {min_req_s:.0f} req/s floor")
+    ups = sum(1 for d in scaler.decisions if d.action == "scale_up")
+    downs = sum(1 for d in scaler.decisions if d.action == "scale_down")
+    return {
+        "serve_soak_req_s": _sane("serve_soak_req_s",
+                                  res.achieved_req_s),
+        "serve_soak_offered_req_s": _sane(
+            "serve_soak_offered_req_s", res.submitted / duration_s),
+        "serve_soak_p50_ms": p50,
+        "serve_soak_p99_ms": p99,
+        "serve_soak_submitted": float(res.submitted),
+        "serve_soak_lost": float(res.lost),
+        "serve_soak_scale_ups": float(ups),
+        "serve_soak_scale_downs": float(downs),
+        "serve_soak_final_workers": float(inf.coalescer_workers),
+        "serve_soak_dispatches": float(st["dispatches"]),
+    }
+
+
+def bench_metrics_overhead(n_requests: int = 1024, max_batch: int = 128,
+                           reps: int = 5):
+    """Registry publication cost on the two hot serving paths
+    (acceptance: <2%, the guard_overhead discipline). Each leg runs an
+    identical workload twice — once against the real leaf-locked
+    ``MetricsRegistry``, once against the no-op ``NullRegistry`` — and
+    reports the throughput delta as a percentage.
+
+    Leg 1 is the ``inference_serve`` worst case (every request one
+    LeNet row, all batching the coalescer's): counter incs + latency
+    histogram per request. Leg 2 is continuous-batching generation on
+    a deliberately SMALL TransformerLM — decode steps are cheap, so
+    the per-dispatch publication cost is measured against the least
+    compute it could hide behind. Median of ``reps`` timed passes per
+    leg, all samples recorded; the bench RAISES past the 2% gate."""
+    from deeplearning4j_tpu.metrics.registry import (MetricsRegistry,
+                                                     NullRegistry)
+    from deeplearning4j_tpu.models import LeNet, TransformerLM
+    from deeplearning4j_tpu.parallel.generation import GenerationServer
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+    rs = np.random.RandomState(3)
+    xs = rs.randn(256, 1, 28, 28, 1).astype(np.float32)
+    net = LeNet(num_labels=10).init()
+
+    def inf_leg(make_reg):
+        with ParallelInference(net, max_batch=max_batch,
+                               max_wait_ms=2.0,
+                               max_pending=4 * n_requests,
+                               registry=make_reg()) as inf:
+            inf.submit(xs[0]).result(timeout=120)
+            inf.output(xs[:max_batch, 0])
+            samples = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                futs = [inf.submit(xs[i % len(xs)])
+                        for i in range(n_requests)]
+                for f in futs:
+                    f.result(timeout=120)
+                samples.append(n_requests / (time.perf_counter() - t0))
+        return float(np.median(samples)), [round(s, 1) for s in samples]
+
+    vocab = 256
+    lm = TransformerLM(num_labels=vocab, max_length=64, d_model=64,
+                       n_heads=4, n_blocks=2, seed=0).init()
+    for v in lm.conf.vertices.values():
+        lyr = getattr(v, "layer", None)
+        if lyr is not None and hasattr(lyr, "max_cache"):
+            lyr.max_cache = 64
+    shapes = [(6, 24), (14, 32), (6, 32), (14, 24)]
+    reqs = [(rs.randint(0, vocab, shapes[i % 4][0]), shapes[i % 4][1])
+            for i in range(32)]
+    n_tokens = sum(steps for _, steps in reqs)
+
+    def gen_leg(make_reg):
+        srv = GenerationServer(lm, vocab, slots=16, steps_per_dispatch=8,
+                               max_pending=128, registry=make_reg())
+        try:
+            for f in [srv.submit(p, 2) for p, _ in reqs[:2]]:
+                f.result(timeout=SUB_BENCH_TIMEOUT_S)
+            samples = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                futs = [srv.submit(p, steps) for p, steps in reqs]
+                for f in futs:
+                    f.result(timeout=SUB_BENCH_TIMEOUT_S)
+                samples.append(n_tokens / (time.perf_counter() - t0))
+        finally:
+            srv.close()
+        return float(np.median(samples)), [round(s, 1) for s in samples]
+
+    out = {}
+    for prefix, leg, unit_key in (("metrics", inf_leg, "req_s"),
+                                  ("metrics_gen", gen_leg, "tokens_s")):
+        off, off_samples = leg(NullRegistry)
+        on, on_samples = leg(MetricsRegistry)
+        pct = (off - on) / off * 100.0
+        if pct > 2.0:
+            raise RuntimeError(
+                f"{prefix} publication overhead {pct:.2f}% — above the "
+                "2% gate the boundary-only-writes design exists to "
+                "clear")
+        out[f"{prefix}_off_{unit_key}"] = _sane(
+            f"{prefix}_off_{unit_key}", off)
+        out[f"{prefix}_off_samples"] = off_samples
+        out[f"{prefix}_on_{unit_key}"] = _sane(
+            f"{prefix}_on_{unit_key}", on)
+        out[f"{prefix}_on_samples"] = on_samples
+        out[f"{prefix}_overhead_pct"] = pct
+    return out
 
 
 def bench_word2vec(n_sentences: int = 50000, epochs: int = 1):
@@ -1064,6 +1284,12 @@ SANITY_CEILING = {
     "guard_on_img_s": 1e8,
     "guard_off_img_s": 1e8,
     "inference_serve_req_s": 1e8,
+    "serve_soak_req_s": 1e8,
+    "serve_soak_offered_req_s": 1e8,
+    "metrics_off_req_s": 1e8,
+    "metrics_on_req_s": 1e8,
+    "metrics_gen_off_tokens_s": 1e9,
+    "metrics_gen_on_tokens_s": 1e9,
     "serve_chaos_req_s": 1e8,
     "serve_fleet_req_s": 1e8,
     "serve_fleet_1rep_req_s": 1e8,
@@ -1106,6 +1332,22 @@ METRIC_UNIT = {
     "inference_serve_p50_ms": "ms",
     "inference_serve_p99_ms": "ms",
     "inference_serve_dispatches": "",
+    "serve_soak_req_s": "req/s",
+    "serve_soak_offered_req_s": "req/s",
+    "serve_soak_p50_ms": "ms",
+    "serve_soak_p99_ms": "ms",
+    "serve_soak_submitted": "",
+    "serve_soak_lost": "",
+    "serve_soak_scale_ups": "",
+    "serve_soak_scale_downs": "",
+    "serve_soak_final_workers": "",
+    "serve_soak_dispatches": "",
+    "metrics_off_req_s": "req/s",
+    "metrics_on_req_s": "req/s",
+    "metrics_overhead_pct": "%",
+    "metrics_gen_off_tokens_s": "tokens/s",
+    "metrics_gen_on_tokens_s": "tokens/s",
+    "metrics_gen_overhead_pct": "%",
     "serve_chaos_req_s": "req/s",
     "serve_chaos_p50_ms": "ms",
     "serve_chaos_p99_ms": "ms",
@@ -1357,8 +1599,9 @@ def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     valid = ("all", "resnet50", "vgg16", "lenet", "lstm", "transformer",
              "word2vec", "doc2vec", "attention", "fit_e2e", "eval_e2e",
-             "guard_overhead", "inference_serve", "serve_chaos",
-             "serve_fleet", "generate_serve", "generate_longtail")
+             "guard_overhead", "metrics_overhead", "inference_serve",
+             "serve_chaos", "serve_fleet", "serve_soak",
+             "generate_serve", "generate_longtail")
     if which not in valid:
         sys.exit(f"Unknown model '{which}'; choose one of {valid}")
     # persistent XLA compile cache: repeated bench runs skip the
@@ -1403,6 +1646,9 @@ def main():
     if which in ("all", "guard_overhead"):
         _sub_metric(extras, "guard_overhead", bench_guard_overhead)
         headline and headline.sample("post-guard-overhead")
+    if which in ("all", "metrics_overhead"):
+        _sub_metric(extras, "metrics_overhead", bench_metrics_overhead)
+        headline and headline.sample("post-metrics-overhead")
     if which in ("all", "inference_serve"):
         _sub_metric(extras, "inference_serve", bench_inference_serve)
         headline and headline.sample("post-inference-serve")
@@ -1412,6 +1658,9 @@ def main():
     if which in ("all", "serve_fleet"):
         _sub_metric(extras, "serve_fleet", bench_serve_fleet)
         headline and headline.sample("post-serve-fleet")
+    if which in ("all", "serve_soak"):
+        _sub_metric(extras, "serve_soak", bench_serve_soak)
+        headline and headline.sample("post-serve-soak")
     if which in ("all", "generate_serve"):
         _sub_metric(extras, "generate_serve", bench_generate_serve)
     if which in ("all", "generate_longtail"):

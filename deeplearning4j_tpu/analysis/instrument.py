@@ -24,8 +24,13 @@ rank  lock
 60    AdmissionController._lock
 70    CircuitBreaker._lock
 80    RetryPolicy._lock
-90    *._stats_lock
 ====  =====================================
+
+(Serving stats counters moved into the per-metric leaf locks of the
+metrics registry — metrics/registry.py — which rank strictly last:
+registry publication never happens while holding a serving lock, and a
+scrape takes no serving lock, so the registry stays out of the ranked
+set.)
 """
 
 from __future__ import annotations
@@ -151,11 +156,9 @@ def _targets() -> Dict[type, Dict[str, Tuple[int, bool]]]:
 
     return {
         StreamingBroker: {"_lock": (10, False)},
-        ParallelInference: {"_lock": (20, False), "_drain_cv": (30, True),
-                            "_stats_lock": (90, False)},
+        ParallelInference: {"_lock": (20, False), "_drain_cv": (30, True)},
         GenerationServer: {"_cond": (30, True)},
-        KerasBackendServer: {"_lock": (40, False),
-                             "_stats_lock": (90, False)},
+        KerasBackendServer: {"_lock": (40, False)},
         AdmissionController: {"_lock": (60, False)},
         CircuitBreaker: {"_lock": (70, False)},
         RetryPolicy: {"_lock": (80, False)},

@@ -19,6 +19,7 @@ an HTTP entry point serves any client), batches are ``.npz`` files with
                   "deadline_s": 2.0}                    -> {"tokens": [..]}
 - GET  /models                                          -> {"models": [..]}
 - GET  /stats                                           -> serving counters
+- GET  /metrics                     -> Prometheus text exposition (0.0.4)
 
 /generate serves models registered with ``attach_generation`` through a
 slot-pooled continuous-batching ``GenerationServer``
@@ -46,6 +47,9 @@ from typing import Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.metrics.exposition import CONTENT_TYPE, render_text
+from deeplearning4j_tpu.metrics.registry import (MetricsRegistry,
+                                                 global_registry)
 from deeplearning4j_tpu.parallel.resilience import (AdmissionController,
                                                     ChaosPolicy,
                                                     CircuitBreaker,
@@ -81,7 +85,8 @@ class KerasBackendServer:
                  request_deadline_s: Optional[float] = None,
                  retry: Optional[RetryPolicy] = None,
                  breaker: Optional[CircuitBreaker] = None,
-                 chaos: Optional[ChaosPolicy] = None):
+                 chaos: Optional[ChaosPolicy] = None,
+                 registry: Optional[MetricsRegistry] = None):
         """Resilience knobs mirror ``ParallelInference``: ``max_pending``
         bounds concurrent in-flight requests (beyond it /predict returns
         429 immediately), ``request_deadline_s`` is the default /predict
@@ -108,12 +113,35 @@ class KerasBackendServer:
         self.retry = retry if retry is not None else RetryPolicy()
         self.breaker = breaker if breaker is not None else CircuitBreaker()
         self._chaos = chaos
-        self._stats_lock = threading.Lock()
-        self._retried = 0
-        self._expired = 0
-        self._rejected_circuit = 0
-        self._completed = 0
-        self._failed = 0
+        # serving counters live in the (leaf-locked) registry; GET
+        # /metrics renders this registry merged with every attached
+        # model's own (labeled by model id) plus any register_metrics()
+        # extras and the process-global training registry
+        self.metrics = registry if registry is not None \
+            else MetricsRegistry()
+        self._m_retried = self.metrics.counter(
+            "server_retried_total", "dispatch retries")
+        self._m_expired = self.metrics.counter(
+            "server_expired_total", "requests failed on deadline")
+        self._m_rejected_circuit = self.metrics.counter(
+            "server_rejected_circuit_total",
+            "requests shed while the breaker was open")
+        self._m_completed = self.metrics.counter(
+            "server_completed_total", "requests completed")
+        self._m_failed = self.metrics.counter(
+            "server_failed_total", "requests failed on error")
+        self.metrics.gauge("server_pending",
+                           "admitted-but-unresolved requests",
+                           fn=lambda: self.admission.pending)
+        self.metrics.gauge("server_accepted",
+                           "requests accepted by admission",
+                           fn=lambda: self.admission.accepted)
+        self.metrics.gauge("server_rejected",
+                           "requests rejected by admission",
+                           fn=lambda: self.admission.rejected)
+        self.metrics.gauge("server_models", "imported models",
+                           fn=lambda: len(self._models))
+        self._extra_metrics: list = []
 
     @property
     def port(self) -> int:
@@ -171,13 +199,11 @@ class KerasBackendServer:
             return {"accuracy": ev.accuracy(), "f1": ev.f1()}
 
     def _count_retry(self, attempt, exc) -> None:
-        with self._stats_lock:
-            self._retried += 1
+        self._m_retried.inc()
 
     def _check_deadline(self, deadline: Optional[Deadline], stage: str):
         if deadline is not None and deadline.expired():
-            with self._stats_lock:
-                self._expired += 1
+            self._m_expired.inc()
             raise DeadlineExceeded(
                 f"request budget exhausted {stage} "
                 f"({-deadline.remaining() * 1e3:.1f} ms over)")
@@ -204,16 +230,13 @@ class KerasBackendServer:
                 out = fut.result(timeout=None if budget is None
                                  else budget + 30.0)
             except Exception:
-                with self._stats_lock:
-                    self._failed += 1
+                self._m_failed.inc()
                 raise
-            with self._stats_lock:
-                self._completed += 1
+            self._m_completed.inc()
             return np.asarray(out).tolist()
         deadline = None if budget is None else Deadline(budget)
         if not self.breaker.allow():
-            with self._stats_lock:
-                self._rejected_circuit += 1
+            self._m_rejected_circuit.inc()
             raise CircuitOpen("circuit breaker is open: recent dispatches "
                               "failed above threshold")
         self.admission.acquire()  # raises ServerOverloaded at watermark
@@ -237,11 +260,9 @@ class KerasBackendServer:
 
                 out = self.retry.call(attempt, deadline=deadline,
                                       on_retry=self._count_retry)
-            with self._stats_lock:
-                self._completed += 1
+            self._m_completed.inc()
         except Exception:
-            with self._stats_lock:
-                self._failed += 1
+            self._m_failed.inc()
             raise
         finally:
             self.admission.release()
@@ -361,11 +382,9 @@ class KerasBackendServer:
             out = fut.result(timeout=None if budget is None
                              else budget + 30.0)
         except Exception:
-            with self._stats_lock:
-                self._failed += 1
+            self._m_failed.inc()
             raise
-        with self._stats_lock:
-            self._completed += 1
+        self._m_completed.inc()
         return np.asarray(out).tolist()
 
     def list_models(self) -> list:
@@ -374,11 +393,14 @@ class KerasBackendServer:
 
     def stats(self) -> dict:
         """Per-server serving counters (the /stats endpoint body): the
-        observable surface for the UI, bench, and ops."""
-        with self._stats_lock:
-            out = {"retried": self._retried, "expired": self._expired,
-                   "rejected_circuit": self._rejected_circuit,
-                   "completed": self._completed, "failed": self._failed}
+        observable surface for the UI, bench, and ops. Counters come off
+        the registry; the legacy key set and order are preserved
+        byte-for-byte."""
+        out = {"retried": int(self._m_retried.value),
+               "expired": int(self._m_expired.value),
+               "rejected_circuit": int(self._m_rejected_circuit.value),
+               "completed": int(self._m_completed.value),
+               "failed": int(self._m_failed.value)}
         out.update(accepted=self.admission.accepted,
                    rejected=self.admission.rejected,
                    pending=self.admission.pending,
@@ -395,6 +417,43 @@ class KerasBackendServer:
         if infs:
             out["inference"] = {mid: i.stats() for mid, i in infs.items()}
         return out
+
+    def register_metrics(self, labels: Optional[dict],
+                         registry: MetricsRegistry) -> None:
+        """Expose an additional registry on GET /metrics (a broker's, a
+        training health guard's, ...) with ``labels`` injected on every
+        sample it contributes."""
+        with self._lock:
+            self._extra_metrics.append((dict(labels or {}), registry))
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition (0.0.4) over every registry this
+        server can see: its own serving counters, each attached
+        generation/inference target's registry labeled ``model=<id>``
+        (a fleet contributes its fleet-level aggregates), any
+        ``register_metrics`` extras, and the process-global registry
+        (training/health telemetry). Duplicate registry objects render
+        once — first labeling wins."""
+        with self._lock:
+            gens = dict(self._generators)
+            extras = list(self._extra_metrics)
+        with self._inference_lock:
+            infs = dict(self._inference)
+        sources = [({}, self.metrics)]
+        seen = {id(self.metrics)}
+        for mid, target in list(gens.items()) + list(infs.items()):
+            reg = getattr(target, "metrics", None)
+            if reg is not None and id(reg) not in seen:
+                seen.add(id(reg))
+                sources.append(({"model": mid}, reg))
+        for labels, reg in extras:
+            if id(reg) not in seen:
+                seen.add(id(reg))
+                sources.append((labels, reg))
+        gl = global_registry()
+        if id(gl) not in seen:
+            sources.append(({}, gl))
+        return render_text(sources)
 
     # ----------------------------------------------------------- lifecycle
     def start(self) -> int:
@@ -420,6 +479,13 @@ class KerasBackendServer:
                     self._json({"models": server.list_models()})
                 elif self.path == "/stats":
                     self._json(server.stats())
+                elif self.path == "/metrics":
+                    body = server.metrics_text().encode("utf-8")
+                    self.send_response(200)
+                    self.send_header("Content-Type", CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 else:
                     self._error(404, "not found", "NotFound")
 

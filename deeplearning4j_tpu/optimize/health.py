@@ -46,6 +46,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.metrics.registry import global_registry
+
 
 class DivergenceError(RuntimeError):
     """Training diverged and the recovery ladder is exhausted."""
@@ -105,7 +107,7 @@ class HealthPolicy:
                  skip_threshold: int = 8, spike_factor: float = 10.0,
                  ema_alpha: float = 0.1, warmup_steps: int = 20,
                  lr_backoff: Optional[float] = 0.5,
-                 max_recoveries: int = 3):
+                 max_recoveries: int = 3, registry=None):
         if lr_backoff is not None and not 0.0 < lr_backoff < 1.0:
             raise ValueError(
                 f"lr_backoff must be in (0, 1) or None, got {lr_backoff}")
@@ -130,6 +132,21 @@ class HealthPolicy:
         self.events: list = []  # every emitted report, for observability
         self._window_start: Optional[int] = None
         self._invalidate = None
+        # publish into the shared registry (default: the process-global
+        # one, so a serving process scrapes its training health too)
+        self.metrics = registry if registry is not None \
+            else global_registry()
+        self._m_events = self.metrics.counter(
+            "health_events_total", "health-guard reports by action",
+            labels=("action",))
+        self._m_ema = self.metrics.gauge(
+            "health_loss_ema", "EMA loss baseline of the spike detector")
+        self._m_consecutive = self.metrics.gauge(
+            "health_consecutive_skips", "current consecutive skipped steps")
+        self._m_total_skips = self.metrics.gauge(
+            "health_total_skips", "total device-skipped steps")
+        self._m_recoveries = self.metrics.gauge(
+            "health_recoveries", "recovery-ladder rungs walked")
 
     # ------------------------------------------------------------- binding
     def bind(self, net, invalidate=None) -> "HealthPolicy":
@@ -289,6 +306,11 @@ class HealthPolicy:
     # -------------------------------------------------------------- events
     def _emit(self, net, report: dict):
         self.events.append(report)
+        self._m_events.labels(action=report.get("action", "unknown")).inc()
+        self._m_ema.set(self.ema if self.ema is not None else 0.0)
+        self._m_consecutive.set(self.consecutive_skips)
+        self._m_total_skips.set(self.total_skips)
+        self._m_recoveries.set(self.recoveries)
         for listener in getattr(net, "listeners", []) or []:
             hook = getattr(listener, "on_health", None)
             if hook is not None:

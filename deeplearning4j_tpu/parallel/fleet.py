@@ -58,6 +58,7 @@ from collections import deque
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from deeplearning4j_tpu.metrics.registry import MetricsRegistry
 from deeplearning4j_tpu.parallel.resilience import (
     AdmissionController, CircuitBreaker, CircuitOpen, Deadline,
     DeadlineExceeded, ReplicaKilled, ReplicaUnavailable, ResilienceError,
@@ -166,7 +167,8 @@ class ReplicaFleet:
                  warmup: Optional[Callable[[Any], None]] = None,
                  breaker_factory: Optional[Callable[[], CircuitBreaker]]
                  = None,
-                 health_alpha: float = 0.25, tick_s: float = 0.005):
+                 health_alpha: float = 0.25, tick_s: float = 0.005,
+                 registry: Optional[MetricsRegistry] = None):
         if int(replicas) < 1:
             raise ValueError("need at least one replica")
         self._factory = factory
@@ -191,16 +193,48 @@ class ReplicaFleet:
         self._replicas: List[_Replica] = []
         self._closing = False
         self._stop = False
-        self._submitted = 0
-        self._rejected_submits = 0
-        self._completed = 0
-        self._failed = 0
-        self._expired = 0
-        self._redispatched = 0
-        self._hedged = 0
-        self._losers_cancelled = 0
-        self._deaths = 0
-        self._restarts = 0
+        # fleet-wide aggregates live in the (leaf-locked) registry: the
+        # routing path and completion callbacks publish without holding
+        # _cond, and a scrape never contends with routing. Per-replica
+        # traffic fields stay plain on _Replica, guarded by _cond.
+        self.metrics = registry if registry is not None \
+            else MetricsRegistry()
+        m = self.metrics
+        self._m_submitted = m.counter(
+            "fleet_submitted_total", "requests offered to the fleet")
+        self._m_rejected_submits = m.counter(
+            "fleet_rejected_submits_total",
+            "submits shed typed before acceptance")
+        self._m_completed = m.counter(
+            "fleet_completed_total", "requests completed")
+        self._m_failed = m.counter(
+            "fleet_failed_total", "requests failed on error")
+        self._m_expired = m.counter(
+            "fleet_expired_total", "requests failed on deadline")
+        self._m_redispatched = m.counter(
+            "fleet_redispatched_total",
+            "attempts re-parked after a replica failure")
+        self._m_hedged = m.counter(
+            "fleet_hedged_total", "straggler hedge attempts launched")
+        self._m_losers_cancelled = m.counter(
+            "fleet_losers_cancelled_total",
+            "duplicate attempts cancelled after a winner")
+        self._m_deaths = m.counter(
+            "fleet_deaths_total", "replica deaths observed")
+        self._m_restarts = m.counter(
+            "fleet_restarts_total", "supervised replica restarts")
+        m.gauge("fleet_replicas", "replica slots in the fleet",
+                fn=lambda: len(self._replicas))
+        m.gauge("fleet_parked", "requests parked for re-dispatch",
+                fn=lambda: len(self._pending))
+        m.gauge("fleet_inflight", "unresolved accepted requests",
+                fn=lambda: len(self._inflight_reqs))
+        m.gauge("fleet_pending", "admission high-watermark occupancy",
+                fn=lambda: self.admission.pending)
+        m.gauge("fleet_accepted", "requests accepted by fleet admission",
+                fn=lambda: self.admission.accepted)
+        m.gauge("fleet_rejected", "requests rejected by fleet admission",
+                fn=lambda: self.admission.rejected)
 
         for rid in range(int(replicas)):
             server = factory(rid)  # spawn errors propagate at construction
@@ -258,8 +292,8 @@ class ReplicaFleet:
             args, kwargs,
             None if deadline_s is None else Deadline(deadline_s), fut)
         with self._cond:
-            self._submitted += 1
             self._inflight_reqs.add(freq)
+        self._m_submitted.inc()
         try:
             routed, reason = self._route_once(freq)
         except ValueError:
@@ -292,9 +326,9 @@ class ReplicaFleet:
                 return False
             rep.state = DEAD
             rep.restart_at = time.monotonic() + rep.backoff_s
-            self._deaths += 1
             server = rep.server
             self._cond.notify_all()
+        self._m_deaths.inc()
         try:
             server.close(timeout=0.0)
         except Exception:
@@ -376,21 +410,8 @@ class ReplicaFleet:
     def stats(self) -> dict:
         with self._cond:
             reps = list(self._replicas)
-            out = {
-                "replica_count": len(reps),
-                "submitted": self._submitted,
-                "rejected_submits": self._rejected_submits,
-                "completed": self._completed,
-                "failed": self._failed,
-                "expired": self._expired,
-                "redispatched": self._redispatched,
-                "hedged": self._hedged,
-                "losers_cancelled": self._losers_cancelled,
-                "deaths": self._deaths,
-                "restarts": self._restarts,
-                "parked": len(self._pending),
-                "inflight": len(self._inflight_reqs),
-            }
+            parked = len(self._pending)
+            inflight = len(self._inflight_reqs)
             per = []
             for r in reps:
                 per.append({
@@ -408,6 +429,23 @@ class ReplicaFleet:
                     "failed": r.failed,
                     "rejected": r.rejected,
                 })
+        # aggregate counters come off the registry — assembled OUTSIDE
+        # _cond — and the legacy key set/order is preserved byte-for-byte
+        out = {
+            "replica_count": len(reps),
+            "submitted": int(self._m_submitted.value),
+            "rejected_submits": int(self._m_rejected_submits.value),
+            "completed": int(self._m_completed.value),
+            "failed": int(self._m_failed.value),
+            "expired": int(self._m_expired.value),
+            "redispatched": int(self._m_redispatched.value),
+            "hedged": int(self._m_hedged.value),
+            "losers_cancelled": int(self._m_losers_cancelled.value),
+            "deaths": int(self._m_deaths.value),
+            "restarts": int(self._m_restarts.value),
+            "parked": parked,
+            "inflight": inflight,
+        }
         # server/breaker/admission calls take their own locks: keep them
         # outside _cond (replica callbacks already hold server locks when
         # they take _cond, so the reverse order would be a lock cycle)
@@ -525,7 +563,8 @@ class ReplicaFleet:
                 freq.t_dispatch = t0
                 if hedge:
                     freq.hedges += 1
-                    self._hedged += 1
+            if hedge:
+                self._m_hedged.inc()
             # if `inner` is already done this fires the callback inline
             inner.add_done_callback(
                 functools.partial(self._replica_done, freq, rep, t0))
@@ -558,15 +597,17 @@ class ReplicaFleet:
                 rep.failed += 1
                 rep.fail_ewma = ((1.0 - self._alpha) * rep.fail_ewma
                                  + self._alpha)
-            if died and current and rep.state == READY:
+            counted_death = died and current and rep.state == READY
+            if counted_death:
                 rep.state = DEAD
                 rep.restart_at = time.monotonic() + rep.backoff_s
-                self._deaths += 1
             freq.active.pop(rep.rid, None)
             has_twin = len(freq.active) > 0
             is_resolved = freq.resolved
             stopping = self._stop
             self._cond.notify_all()
+        if counted_death:
+            self._m_deaths.inc()
         rep.admission.release()
         if cancelled:
             return
@@ -595,11 +636,13 @@ class ReplicaFleet:
             self._resolve(freq, None, exc)
             return
         with self._cond:
-            if not freq.resolved and not self._stop:
+            parked = not freq.resolved and not self._stop
+            if parked:
                 self._pending.append(freq)
-                self._redispatched += 1
                 self._cond.notify_all()
-                return
+        if parked:
+            self._m_redispatched.inc()
+            return
         self._resolve(freq, None, exc)
 
     def _resolve(self, freq: _FleetRequest, value: Any,
@@ -618,16 +661,17 @@ class ReplicaFleet:
             freq.resolved = True
             self._inflight_reqs.discard(freq)
             losers = list(freq.active.values())
-            self._losers_cancelled += len(losers)
-            if rejected or (exc is None and value is None):
-                self._rejected_submits += 1
-            elif exc is None:
-                self._completed += 1
-            elif isinstance(exc, DeadlineExceeded):
-                self._expired += 1
-            else:
-                self._failed += 1
             self._cond.notify_all()
+        if losers:
+            self._m_losers_cancelled.inc(len(losers))
+        if rejected or (exc is None and value is None):
+            self._m_rejected_submits.inc()
+        elif exc is None:
+            self._m_completed.inc()
+        elif isinstance(exc, DeadlineExceeded):
+            self._m_expired.inc()
+        else:
+            self._m_failed.inc()
         for loser in losers:
             loser.cancel()  # queued attempts die; running ones are ignored
         try:
@@ -732,5 +776,5 @@ class ReplicaFleet:
             fresh.failed = old.failed
             fresh.rejected = old.rejected
             self._replicas[rid] = fresh
-            self._restarts += 1
             self._cond.notify_all()
+        self._m_restarts.inc()

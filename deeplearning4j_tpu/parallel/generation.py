@@ -58,6 +58,7 @@ from typing import Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.metrics.registry import MetricsRegistry
 from deeplearning4j_tpu.optimize.bucketing import bucket_length, bucket_pages
 from deeplearning4j_tpu.parallel.resilience import (AdmissionController,
                                                     ChaosPolicy,
@@ -210,7 +211,8 @@ class GenerationServer:
                  spec_k: int = 4,
                  retry: Optional[RetryPolicy] = None,
                  breaker: Optional[CircuitBreaker] = None,
-                 chaos: Optional[ChaosPolicy] = None):
+                 chaos: Optional[ChaosPolicy] = None,
+                 registry: Optional[MetricsRegistry] = None):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if page_size < 1:
@@ -273,6 +275,7 @@ class GenerationServer:
         self._queue: deque = deque()
         self._slot_req: list = [None] * self.slots
         self._n_active = 0
+        self._active_cap = self.slots
         self._closing = False
         self._stop = False
 
@@ -291,30 +294,113 @@ class GenerationServer:
         self._admit_seq = 0
         self._page_pool = _PagePool(self.pages_total)
 
-        self._admitted = 0
-        self._expired = 0
-        self._retired = 0
-        self._completed = 0
-        self._failed = 0
-        self._retried = 0
-        self._pool_rebuilds = 0
-        self._prefills = 0
-        self._decode_steps = 0
-        self._tokens = 0
-        self._busy_s = 0.0
-        self._cow_copies = 0
-        self._preempted = 0
-        self._prefix_hits = 0
-        self._prefix_tokens_reused = 0
-        self._spec_rounds = 0
-        self._spec_proposed = 0
-        self._spec_accepted = 0
+        # serving counters live in the (leaf-locked) registry, so the
+        # loop thread publishes without ever touching ``_cond`` and a
+        # scrape never blocks admission; ``_cond`` only guards queue and
+        # slot structure
+        self.metrics = registry if registry is not None \
+            else MetricsRegistry()
+        m = self.metrics
+        self._m_admitted = m.counter(
+            "generation_admitted_total", "requests committed to a slot")
+        self._m_expired = m.counter(
+            "generation_expired_total", "requests failed on deadline")
+        self._m_retired = m.counter(
+            "generation_retired_total", "slots retired")
+        self._m_completed = m.counter(
+            "generation_completed_total", "requests completed")
+        self._m_failed = m.counter(
+            "generation_failed_total", "requests failed on error")
+        self._m_retried = m.counter(
+            "generation_retried_total", "dispatch retries")
+        self._m_pool_rebuilds = m.counter(
+            "generation_pool_rebuilds_total",
+            "device-state rebuilds after a hard dispatch fault")
+        self._m_prefills = m.counter(
+            "generation_prefills_total", "prompts prefilled")
+        self._m_decode_steps = m.counter(
+            "generation_decode_steps_total", "decode dispatches")
+        self._m_tokens = m.counter(
+            "generation_tokens_total", "tokens generated")
+        self._m_busy_s = m.counter(
+            "generation_busy_seconds_total",
+            "wall seconds spent in prefill/decode dispatches")
+        self._m_cow_copies = m.counter(
+            "generation_cow_copies_total", "copy-on-write page copies")
+        self._m_preempted = m.counter(
+            "generation_preempted_total",
+            "slots preempted under page-pool pressure")
+        self._m_prefix_hits = m.counter(
+            "generation_prefix_hits_total",
+            "prompts that reused a cached prefix")
+        self._m_prefix_reused = m.counter(
+            "generation_prefix_tokens_reused_total",
+            "prompt tokens served from the prefix cache")
+        self._m_spec_rounds = m.counter(
+            "generation_spec_rounds_total", "speculative decode rounds")
+        self._m_spec_proposed = m.counter(
+            "generation_spec_proposed_total", "draft tokens proposed")
+        self._m_spec_accepted = m.counter(
+            "generation_spec_accepted_total", "draft tokens accepted")
+        m.gauge("generation_slots", "decode slot pool size",
+                fn=lambda: self.slots)
+        m.gauge("generation_active_slots", "slots currently decoding",
+                fn=lambda: self._n_active)
+        m.gauge("generation_active_slot_cap",
+                "autoscaler admission cap on concurrently active slots",
+                fn=lambda: self._active_cap)
+        m.gauge("generation_queue_depth", "requests waiting for a slot",
+                fn=lambda: len(self._queue))
+        m.gauge("generation_pending", "admitted-but-unresolved requests",
+                fn=lambda: self.admission.pending)
+        m.gauge("generation_accepted", "requests accepted by admission",
+                fn=lambda: self.admission.accepted)
+        m.gauge("generation_rejected", "requests rejected by admission",
+                fn=lambda: self.admission.rejected)
+        m.gauge("generation_breaker_open",
+                "circuit state (0 closed, 0.5 half-open, 1 open)",
+                fn=self._breaker_level)
+        m.gauge("generation_pages_free", "unallocated KV pages",
+                fn=lambda: len(self._page_pool.free))
+        m.gauge("generation_pages_cached", "prefix-cache-pinned KV pages",
+                fn=lambda: len(self._page_pool.cache))
+        m.gauge("generation_resident_kv_bytes", "bytes of resident KV",
+                fn=lambda: self._page_pool.in_use() * self._page_bytes)
 
         self._pool = self._fresh_pool()
         self._dpool = None if draft_net is None else self._fresh_draft_pool()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="generation-server")
         self._thread.start()
+
+    def _breaker_level(self) -> float:
+        if self.breaker is None:
+            return 0.0
+        return {"closed": 0.0, "half_open": 0.5,
+                "open": 1.0}.get(self.breaker.state, 0.0)
+
+    @property
+    def active_slot_cap(self) -> int:
+        """Admission cap on concurrently ACTIVE slots. Slot count is
+        baked into the compiled program shapes, so autoscaling never
+        resizes the pool — it bounds how many slots ``_admit_free_slots``
+        may fill, which is retrace-free."""
+        with self._cond:
+            return self._active_cap
+
+    def set_active_slots(self, n: int) -> int:
+        """Clamp and apply a new active-slot admission cap (autoscaler
+        hook). Lowering the cap never evicts running requests; it only
+        stops new admissions until occupancy falls below the cap."""
+        n = max(1, min(int(n), self.slots))
+        with self._cond:
+            self._active_cap = n
+            self._cond.notify_all()
+        return n
+
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
 
     # ------------------------------------------------------ introspection
     def _probe_net(self):
@@ -834,8 +920,7 @@ class GenerationServer:
                         self._spec_decode_once()
                     else:
                         self._decode_once()
-                    with self._cond:
-                        self._busy_s += time.monotonic() - t0
+                    self._m_busy_s.inc(time.monotonic() - t0)
                 self._expire_active()
             except Exception as e:  # noqa: BLE001 — a loop death would
                 # hang every outstanding future; fail them typed instead
@@ -843,18 +928,19 @@ class GenerationServer:
 
     def _pop_admittable(self):
         """Next queued request still worth prefilling (expired ones fail
-        typed on the way)."""
-        with self._cond:
-            while self._queue:
+        typed on the way — counted and resolved OUTSIDE ``_cond``)."""
+        while True:
+            with self._cond:
+                if not self._queue:
+                    return None
                 req = self._queue.popleft()
-                if req.deadline is not None and req.deadline.expired():
-                    self._expired += 1
-                    self._fail(req, DeadlineExceeded(
-                        "request budget exhausted while queued "
-                        f"({-req.deadline.remaining() * 1e3:.1f} ms over)"))
-                    continue
-                return req
-        return None
+            if req.deadline is not None and req.deadline.expired():
+                self._m_expired.inc()
+                self._fail(req, DeadlineExceeded(
+                    "request budget exhausted while queued "
+                    f"({-req.deadline.remaining() * 1e3:.1f} ms over)"))
+                continue
+            return req
 
     def _admit_free_slots(self):
         """Admit every queued request a free slot and the page pool can
@@ -862,7 +948,13 @@ class GenerationServer:
         per chunk round (Orca-style iteration-level scheduling: weights
         are read once per round, not once per request)."""
         staged = []                          # (slot, req, pos0, plen, t0)
+        with self._cond:
+            # the autoscaler's admission cap bounds occupancy, not the
+            # pool: slots past the cap stay empty until it rises again
+            budget = self._active_cap - self._n_active
         for s in range(self.slots):
+            if len(staged) >= budget:
+                break
             if self._slot_req[s] is not None:
                 continue
             req = self._pop_admittable()
@@ -881,18 +973,16 @@ class GenerationServer:
                     with self._cond:
                         self._queue.appendleft(req)
                     break
-                with self._cond:
-                    self._failed += 1
+                self._m_failed.inc()
                 self._fail(req, e)
                 continue
             except Exception as e:  # noqa: BLE001 — typed failure for
                 # this request only; the slot stays free for the next one
                 self._release_slot_pages(s)
-                with self._cond:
-                    if isinstance(e, DeadlineExceeded):
-                        self._expired += 1
-                    else:
-                        self._failed += 1
+                if isinstance(e, DeadlineExceeded):
+                    self._m_expired.inc()
+                else:
+                    self._m_failed.inc()
                 self._fail(req, e)
                 continue
             staged.append((s, req, pos0, plen, t0))
@@ -925,7 +1015,7 @@ class GenerationServer:
         invisible in outputs."""
         req = self._slot_req[slot]
         self._release_slot_pages(slot)
-        self._preempted += 1
+        self._m_preempted.inc()
         req.tokens.clear()
         with self._cond:
             self._slot_req[slot] = None
@@ -956,7 +1046,7 @@ class GenerationServer:
         dst = self._alloc_page(slot)
         prog = self._page_copy_program()
         self._pool = prog(self._pool, np.int32(page), np.int32(dst))
-        self._cow_copies += 1
+        self._m_cow_copies.inc()
         self._page_pool.release(page)
         sp[idx] = dst
         self._bt[slot, idx] = dst
@@ -1049,8 +1139,8 @@ class GenerationServer:
         for i, page in enumerate(shared):
             self._bt[slot, i] = page
         if matched:
-            self._prefix_hits += 1
-            self._prefix_tokens_reused += matched
+            self._m_prefix_hits.inc()
+            self._m_prefix_reused.inc(matched)
         self._ensure_slot_pages(slot, plen, write_from=matched)
         return matched
 
@@ -1165,11 +1255,10 @@ class GenerationServer:
                 # wave; every staged slot stays free for the next one
                 for s, req, *_ in group:
                     self._release_slot_pages(s)
-                    with self._cond:
-                        if isinstance(e, DeadlineExceeded):
-                            self._expired += 1
-                        else:
-                            self._failed += 1
+                    if isinstance(e, DeadlineExceeded):
+                        self._m_expired.inc()
+                    else:
+                        self._m_failed.inc()
                     self._fail(req, e)
                 return
             self._pool = new_pool
@@ -1187,11 +1276,10 @@ class GenerationServer:
                     self._draft_prefill(s, req, plen)
                 except Exception as e:  # noqa: BLE001
                     self._release_slot_pages(s)
-                    with self._cond:
-                        if isinstance(e, DeadlineExceeded):
-                            self._expired += 1
-                        else:
-                            self._failed += 1
+                    if isinstance(e, DeadlineExceeded):
+                        self._m_expired.inc()
+                    else:
+                        self._m_failed.inc()
                     self._fail(req, e)
                     continue
             self._commit_slot(s, req, plen, first[s], keys[s], t0)
@@ -1213,12 +1301,12 @@ class GenerationServer:
         self._admit_seq += 1
         self._slot_seq[slot] = self._admit_seq
         with self._cond:
-            self._busy_s += time.monotonic() - t0
-            self._prefills += 1
             self._slot_req[slot] = req
             self._n_active += 1
-            self._admitted += 1
-            self._tokens += 1
+        self._m_busy_s.inc(time.monotonic() - t0)
+        self._m_prefills.inc()
+        self._m_admitted.inc()
+        self._m_tokens.inc()
         if self._finished(req, tok):
             self._retire(slot, req)
 
@@ -1304,10 +1392,9 @@ class GenerationServer:
             self._last[s] = toks[s, m_steps - 1]
             if done:
                 self._retire(s, req)
-        # ONE condition acquisition per decode step, not one per token
-        with self._cond:
-            self._decode_steps += 1
-            self._tokens += ntok
+        # ONE registry publish per decode step, not one per token
+        self._m_decode_steps.inc()
+        self._m_tokens.inc(ntok)
 
     def _spec_decode_once(self):
         import jax
@@ -1341,13 +1428,15 @@ class GenerationServer:
         true, acc = jax.device_get((true, acc))  # ONE fetch per round
         k_spec = self.spec_k
         ntok = 0
+        proposed = 0
+        accepted = 0
         for s in range(self.slots):
             req = self._slot_req[s]
             if req is None:
                 continue
             n = min(acc[s] + 1, k_spec)
-            self._spec_proposed += k_spec - 1
-            self._spec_accepted += n - 1
+            proposed += k_spec - 1
+            accepted += n - 1
             done = False
             for tok in true[s, :n].tolist():
                 req.tokens.append(tok)
@@ -1360,10 +1449,12 @@ class GenerationServer:
             self._last[s] = true[s, n - 1]
             if done:
                 self._retire(s, req)
-        self._spec_rounds += 1
-        with self._cond:
-            self._decode_steps += 1
-            self._tokens += ntok
+        # ONE registry publish per speculative round, not one per slot
+        self._m_spec_rounds.inc()
+        self._m_spec_proposed.inc(proposed)
+        self._m_spec_accepted.inc(accepted)
+        self._m_decode_steps.inc()
+        self._m_tokens.inc(ntok)
 
     def _finished(self, req: _Request, tok) -> bool:
         if req.eos_id is not None and tok == req.eos_id:
@@ -1375,9 +1466,9 @@ class GenerationServer:
         with self._cond:
             self._slot_req[slot] = None
             self._n_active -= 1
-            self._retired += 1
-            self._completed += 1
             self._cond.notify_all()
+        self._m_retired.inc()
+        self._m_completed.inc()
         try:
             req.future.set_result(np.asarray(req.tokens, np.int64))
         except Exception:  # future cancelled/resolved by the caller
@@ -1393,8 +1484,8 @@ class GenerationServer:
             with self._cond:
                 self._slot_req[s] = None
                 self._n_active -= 1
-                self._expired += 1
                 self._cond.notify_all()
+            self._m_expired.inc()
             self._fail(req, DeadlineExceeded(
                 "request budget exhausted mid-generation after "
                 f"{len(req.tokens)} tokens"))
@@ -1419,11 +1510,11 @@ class GenerationServer:
             self._queue.clear()
             self._slot_req = [None] * self.slots
             self._n_active = 0
-            self._failed += len(victims)
             rebuild = not (self._closing or self._stop)
-            if rebuild:
-                self._pool_rebuilds += 1
             self._cond.notify_all()
+        self._m_failed.inc(len(victims))
+        if rebuild:
+            self._m_pool_rebuilds.inc()
         for req in victims:
             self._fail(req, exc)
         if rebuild:
@@ -1439,8 +1530,7 @@ class GenerationServer:
             self._dpool = self._fresh_draft_pool()
 
     def _count_retry(self, attempt, exc):
-        with self._cond:
-            self._retried += 1
+        self._m_retried.inc()
 
     # --------------------------------------------------------- lifecycle
     def drain(self, timeout: Optional[float] = None) -> bool:
@@ -1489,26 +1579,32 @@ class GenerationServer:
     # ------------------------------------------------------------- stats
     def stats(self) -> dict:
         """Serving counters: the observable surface for /stats, the
-        bench, and ops. The ``pages`` block carries the paged-KV gauges
-        (pool occupancy, sharing, COW, speculative accept rate)."""
+        bench, and ops. Counters come off the registry, so ``_cond`` is
+        held only for the structural reads (occupancy and queue depth);
+        the legacy key set and order are preserved byte-for-byte. The
+        ``pages`` block carries the paged-KV gauges (pool occupancy,
+        sharing, COW, speculative accept rate)."""
         with self._cond:
-            out = {
-                "slots": self.slots,
-                "active_slots": self._n_active,
-                "queued": len(self._queue),
-                "admitted": self._admitted,
-                "expired": self._expired,
-                "retired": self._retired,
-                "completed": self._completed,
-                "failed": self._failed,
-                "retried": self._retried,
-                "pool_rebuilds": self._pool_rebuilds,
-                "prefills": self._prefills,
-                "decode_steps": self._decode_steps,
-                "tokens_generated": self._tokens,
-                "tokens_per_s": (self._tokens / self._busy_s
-                                 if self._busy_s > 0 else 0.0),
-            }
+            n_active = self._n_active
+            queued = len(self._queue)
+        busy_s = self._m_busy_s.value
+        tokens = int(self._m_tokens.value)
+        out = {
+            "slots": self.slots,
+            "active_slots": n_active,
+            "queued": queued,
+            "admitted": int(self._m_admitted.value),
+            "expired": int(self._m_expired.value),
+            "retired": int(self._m_retired.value),
+            "completed": int(self._m_completed.value),
+            "failed": int(self._m_failed.value),
+            "retried": int(self._m_retried.value),
+            "pool_rebuilds": int(self._m_pool_rebuilds.value),
+            "prefills": int(self._m_prefills.value),
+            "decode_steps": int(self._m_decode_steps.value),
+            "tokens_generated": tokens,
+            "tokens_per_s": (tokens / busy_s if busy_s > 0 else 0.0),
+        }
         out.update(accepted=self.admission.accepted,
                    rejected=self.admission.rejected,
                    pending=self.admission.pending,
@@ -1516,8 +1612,8 @@ class GenerationServer:
         # page/spec gauges are loop-thread-owned (read unlocked, like
         # _slot_req): a racy snapshot, never a torn structure
         pool = self._page_pool
-        proposed = int(self._spec_proposed)
-        accepted = int(self._spec_accepted)
+        proposed = int(self._m_spec_proposed.value)
+        accepted = int(self._m_spec_accepted.value)
         out["pages"] = {
             "page_size": self._ps,
             "pages_total": pool.total,
@@ -1527,13 +1623,13 @@ class GenerationServer:
             "pages_refcounted": pool.refcounted(),
             "resident_kv_bytes": pool.in_use() * self._page_bytes,
             "peak_resident_kv_bytes": pool.peak * self._page_bytes,
-            "cow_copies": int(self._cow_copies),
-            "prefix_hits": int(self._prefix_hits),
-            "prefix_tokens_reused": int(self._prefix_tokens_reused),
+            "cow_copies": int(self._m_cow_copies.value),
+            "prefix_hits": int(self._m_prefix_hits.value),
+            "prefix_tokens_reused": int(self._m_prefix_reused.value),
             "evictions": int(pool.evictions),
-            "preempted": int(self._preempted),
+            "preempted": int(self._m_preempted.value),
             "spec_k": self.spec_k if self._draft is not None else 0,
-            "spec_rounds": int(self._spec_rounds),
+            "spec_rounds": int(self._m_spec_rounds.value),
             "spec_proposed": proposed,
             "spec_accepted": accepted,
             "spec_accept_rate": (accepted / proposed) if proposed else 0.0,

@@ -48,6 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu.metrics.registry import MetricsRegistry
 from deeplearning4j_tpu.optimize.bucketing import (BoundedCache, bucket_rows,
                                                    pad_rows)
 from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, data_mesh
@@ -59,14 +60,16 @@ from deeplearning4j_tpu.parallel.resilience import (AdmissionController,
                                                     RetryPolicy)
 
 _SHUTDOWN = object()
+_RESIGN = object()  # scale-down token: one coalescer exits, queue stays up
 
 
 class _Request:
     """One submitted observable: input rows + the future its slice lands in
     (the reference's InferenceObservable, minus the wait/notify), plus the
-    request's deadline (None = unbounded)."""
+    request's deadline (None = unbounded) and its submit instant for the
+    e2e latency histogram."""
 
-    __slots__ = ("x", "mask", "n", "future", "deadline")
+    __slots__ = ("x", "mask", "n", "future", "deadline", "t0")
 
     def __init__(self, x, mask, deadline: Optional[Deadline] = None):
         self.x = x
@@ -74,6 +77,7 @@ class _Request:
         self.n = x.shape[0]
         self.future: Future = Future()
         self.deadline = deadline
+        self.t0 = time.monotonic()
 
     def signature(self):
         return (self.x.shape[1:], self.mask is not None)
@@ -86,11 +90,17 @@ class ParallelInference:
                  max_pending: int = 256,
                  retry: Optional[RetryPolicy] = None,
                  breaker: Optional[CircuitBreaker] = None,
-                 chaos: Optional[ChaosPolicy] = None):
+                 chaos: Optional[ChaosPolicy] = None,
+                 coalescers: int = 1, max_coalescers: int = 4,
+                 registry: Optional[MetricsRegistry] = None):
         """``max_batch``/``max_wait_ms`` bound the coalescer: a batch is
         dispatched when it reaches ``max_batch`` rows or ``max_wait_ms``
         after its first request, whichever comes first. ``inflight`` bounds
         the dispatch pipeline (assembled-but-unfetched batches).
+
+        ``coalescers`` sets the initial batcher-thread count on the shared
+        submit queue and ``max_coalescers`` bounds what
+        ``set_coalescer_workers`` (the autoscaler's lever) may grow it to.
 
         Resilience knobs: ``max_pending`` is the admission high-watermark
         (requests beyond it are rejected with ``ServerOverloaded`` instead
@@ -108,9 +118,7 @@ class ParallelInference:
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_ms) / 1e3
         self.inflight = max(1, int(inflight))
-        #: device program calls issued (coalescing efficiency metric: N
-        #: submits completing in 1 dispatch is the point of the batcher)
-        self.dispatch_count = 0
+        self.max_coalescers = max(1, int(max_coalescers))
         self.admission = AdmissionController(max_pending)
         self.retry = retry if retry is not None else RetryPolicy()
         self.breaker = (None if breaker is False
@@ -118,12 +126,40 @@ class ParallelInference:
                         else CircuitBreaker())
         self._dispatch = (chaos.wrap(self._dispatch_fwd) if chaos is not None
                           else self._dispatch_fwd)
-        self._stats_lock = threading.Lock()
-        self._rejected_circuit = 0
-        self._retried = 0
-        self._expired = 0
-        self._completed = 0
-        self._failed = 0
+        # serving counters live in the registry (leaf-locked), so stats()
+        # and the /metrics scrape never take a serving lock
+        self.metrics = registry if registry is not None \
+            else MetricsRegistry()
+        self._m_dispatches = self.metrics.counter(
+            "inference_dispatches_total", "device program calls issued")
+        self._m_rejected_circuit = self.metrics.counter(
+            "inference_rejected_circuit_total",
+            "submits fast-failed by the open breaker")
+        self._m_retried = self.metrics.counter(
+            "inference_retried_total", "dispatch retry attempts")
+        self._m_expired = self.metrics.counter(
+            "inference_expired_total", "requests expired before dispatch")
+        self._m_completed = self.metrics.counter(
+            "inference_completed_total", "futures resolved with rows")
+        self._m_failed = self.metrics.counter(
+            "inference_failed_total", "futures resolved with a typed error")
+        self._m_latency = self.metrics.histogram(
+            "inference_latency_ms", "submit-to-resolution latency")
+        self._m_batch_rows = self.metrics.histogram(
+            "inference_batch_rows", "rows per coalesced dispatch",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+        self.metrics.gauge("inference_pending", "requests in flight",
+                           fn=lambda: self.admission.pending)
+        self.metrics.gauge("inference_accepted", "admission accepts",
+                           fn=lambda: self.admission.accepted)
+        self.metrics.gauge("inference_rejected", "admission rejects",
+                           fn=lambda: self.admission.rejected)
+        self.metrics.gauge("inference_breaker_open",
+                           "0 closed / 0.5 half-open / 1 open",
+                           fn=self._breaker_level)
+        self.metrics.gauge("inference_coalescer_workers",
+                           "live coalescer threads",
+                           fn=lambda: self.coalescer_workers)
         self._drain_cv = threading.Condition()
         self._draining = False
         self._submit_q: Optional[queue.Queue] = None
@@ -131,6 +167,22 @@ class ParallelInference:
         self._threads: list = []
         self._lock = threading.Lock()
         self._closed = False
+        self._coalescer_target = min(self.max_coalescers,
+                                     max(1, int(coalescers)))
+        self._live_coalescers = 0
+        self._coalescer_seq = 0
+
+    def _breaker_level(self) -> float:
+        if self.breaker is None:
+            return 0.0
+        return {"closed": 0.0, "half_open": 0.5,
+                "open": 1.0}.get(self.breaker.state, 0.0)
+
+    @property
+    def dispatch_count(self) -> int:
+        """Device program calls issued (coalescing efficiency metric: N
+        submits completing in 1 dispatch is the point of the batcher)."""
+        return int(self._m_dispatches.value)
 
     # ----------------------------------------------------------- jit cache
     def _get_fwd(self, shape, has_mask):
@@ -186,8 +238,7 @@ class ParallelInference:
         fwd = self._get_fwd(x.shape, mask is not None)
         out = fwd(self.net.params, self.net.state, jnp.asarray(x),
                   jnp.asarray(mask) if mask is not None else None)
-        with self._stats_lock:
-            self.dispatch_count += 1
+        self._m_dispatches.inc()
         return out
 
     # ---------------------------------------------------------- sync entry
@@ -221,8 +272,7 @@ class ParallelInference:
                                    "ParallelInference is draining")
             submit_q = self._ensure_workers()
         if self.breaker is not None and not self.breaker.allow():
-            with self._stats_lock:
-                self._rejected_circuit += 1
+            self._m_rejected_circuit.inc()
             raise CircuitOpen("circuit breaker is open: recent dispatches "
                               "failed above threshold")
         self.admission.acquire()  # raises ServerOverloaded at watermark
@@ -235,7 +285,8 @@ class ParallelInference:
         # the completion counters: it fires on EVERY resolution path
         # (result, typed failure, shutdown drain), so pending can never
         # leak no matter which thread resolves the future
-        req.future.add_done_callback(self._on_done)
+        req.future.add_done_callback(
+            lambda f, t0=req.t0: self._on_done(f, t0))
         submit_q.put(req)
         with self._lock:
             closed = self._closed
@@ -249,24 +300,28 @@ class ParallelInference:
                        RuntimeError("ParallelInference is closed"))
         return req.future
 
-    def _on_done(self, fut: Future) -> None:
+    def _on_done(self, fut: Future, t0: Optional[float] = None) -> None:
         self.admission.release()
-        with self._stats_lock:
-            if fut.exception() is None:
-                self._completed += 1
-            else:
-                self._failed += 1
+        if fut.exception() is None:
+            self._m_completed.inc()
+            if t0 is not None:
+                self._m_latency.observe((time.monotonic() - t0) * 1e3)
+        else:
+            self._m_failed.inc()
         with self._drain_cv:
             self._drain_cv.notify_all()
 
     def stats(self) -> dict:
         """Serving counters (monotone except pending/breaker_state): the
-        observable surface the UI, bench, and ops read."""
-        with self._stats_lock:
-            out = {"retried": self._retried, "expired": self._expired,
-                   "rejected_circuit": self._rejected_circuit,
-                   "completed": self._completed, "failed": self._failed}
-            out["dispatches"] = self.dispatch_count
+        observable surface the UI, bench, and ops read. The snapshot is
+        assembled entirely OUTSIDE the serving locks — every counter is a
+        leaf-locked registry metric (fleet.py's enforced pattern)."""
+        out = {"retried": int(self._m_retried.value),
+               "expired": int(self._m_expired.value),
+               "rejected_circuit": int(self._m_rejected_circuit.value),
+               "completed": int(self._m_completed.value),
+               "failed": int(self._m_failed.value),
+               "dispatches": int(self._m_dispatches.value)}
         out.update(
             accepted=self.admission.accepted,
             rejected=self.admission.rejected,
@@ -285,26 +340,67 @@ class ParallelInference:
             pass
 
     def _ensure_workers(self) -> queue.Queue:
-        """Start the coalescer/completer once and return the submit
+        """Start the coalescer(s)/completer once and return the submit
         queue. Caller must hold ``self._lock``; the worker loops receive
         their queues as arguments so they never re-read the attributes
         outside it."""
         if not self._threads:
             self._submit_q = queue.Queue()
-            # bounded: backpressures the coalescer when `inflight` batches
+            # bounded: backpressures the coalescers when `inflight` batches
             # are dispatched but not yet fetched
             self._inflight_q = queue.Queue(maxsize=self.inflight)
-            coalescer = threading.Thread(
-                target=self._coalesce_loop,
-                args=(self._submit_q, self._inflight_q),
-                name="pi-coalescer", daemon=True)
             completer = threading.Thread(
                 target=self._complete_loop, args=(self._inflight_q,),
                 name="pi-completer", daemon=True)
-            self._threads = [coalescer, completer]
-            coalescer.start()
+            self._threads = [completer]
             completer.start()
+            for _ in range(self._coalescer_target):
+                self._spawn_coalescer_locked()
         return self._submit_q
+
+    def _spawn_coalescer_locked(self) -> None:
+        """Start one coalescer thread on the shared queues. Caller must
+        hold ``self._lock``."""
+        self._coalescer_seq += 1
+        t = threading.Thread(
+            target=self._coalesce_loop,
+            args=(self._submit_q, self._inflight_q),
+            name=f"pi-coalescer-{self._coalescer_seq}", daemon=True)
+        self._live_coalescers += 1
+        self._threads.append(t)
+        t.start()
+
+    @property
+    def coalescer_workers(self) -> int:
+        """Desired coalescer-thread count (the autoscaler's observable)."""
+        with self._lock:
+            return self._coalescer_target
+
+    def set_coalescer_workers(self, n: int) -> int:
+        """Scale the coalescer pool to ``n`` threads (clamped to
+        [1, max_coalescers]). Scale-up spawns threads on the shared
+        submit queue; scale-down enqueues resign tokens, so a coalescer
+        finishes its current batch and exits cleanly. The target never
+        drops below 1, so the shutdown sentinel always finds a live
+        coalescer to propagate through."""
+        n = min(self.max_coalescers, max(1, int(n)))
+        resigns = 0
+        with self._lock:
+            if self._closed:
+                return self._coalescer_target
+            delta = n - self._coalescer_target
+            self._coalescer_target = n
+            if not self._threads:
+                return n  # not started yet: _ensure_workers spawns n
+            if delta > 0:
+                for _ in range(delta):
+                    self._spawn_coalescer_locked()
+            elif delta < 0:
+                resigns = -delta
+            submit_q = self._submit_q
+        for _ in range(resigns):
+            submit_q.put(_RESIGN)
+        return n
 
     def _expire_if_dead(self, req) -> bool:
         """Fail an already-expired request with DeadlineExceeded (True),
@@ -312,8 +408,7 @@ class ParallelInference:
         this BEFORE spending work on the request."""
         if req.deadline is None or not req.deadline.expired():
             return False
-        with self._stats_lock:
-            self._expired += 1
+        self._m_expired.inc()
         self._fail(req.future, DeadlineExceeded(
             f"request expired {-req.deadline.remaining() * 1e3:.1f} ms "
             "before dispatch"))
@@ -332,8 +427,28 @@ class ParallelInference:
         while True:
             first = head if head is not None else q.get()
             head = None
+            if first is _RESIGN:
+                # scale-down token: this coalescer exits, the rest live on.
+                # If a racing close() left this as the last coalescer (the
+                # resign overtook the sentinel chain), forward the shutdown
+                # so the completer still stops; close() drains the now-
+                # ownerless sentinel from the submit queue.
+                with self._lock:
+                    self._live_coalescers -= 1
+                    last = self._live_coalescers <= 0 and self._closed
+                if last:
+                    inflight_q.put(_SHUTDOWN)
+                return
             if first is _SHUTDOWN:
-                inflight_q.put(_SHUTDOWN)
+                # the sentinel walks the whole pool: each coalescer passes
+                # it on, the LAST one forwards it to the completer
+                with self._lock:
+                    self._live_coalescers -= 1
+                    last = self._live_coalescers <= 0
+                if last:
+                    inflight_q.put(_SHUTDOWN)
+                else:
+                    q.put(_SHUTDOWN)
                 return
             if self._expire_if_dead(first):
                 continue
@@ -354,7 +469,8 @@ class ParallelInference:
                     nxt = q.get(timeout=wait)
                 except queue.Empty:
                     break
-                if nxt is _SHUTDOWN or nxt.signature() != sig:
+                if nxt is _SHUTDOWN or nxt is _RESIGN \
+                        or nxt.signature() != sig:
                     head = nxt  # flush now; the mismatch starts its own batch
                     break
                 if self._expire_if_dead(nxt):
@@ -366,8 +482,7 @@ class ParallelInference:
             self._dispatch_batch(batch, inflight_q)
 
     def _count_retry(self, attempt, exc) -> None:
-        with self._stats_lock:
-            self._retried += 1
+        self._m_retried.inc()
 
     def _dispatch_batch(self, batch, inflight_q: queue.Queue):
         # last expiry gate: members that died waiting in the assembly
@@ -375,6 +490,7 @@ class ParallelInference:
         batch = [r for r in batch if not self._expire_if_dead(r)]
         if not batch:
             return
+        self._m_batch_rows.observe(sum(r.n for r in batch))
         earliest = min((r.deadline for r in batch if r.deadline is not None),
                        key=lambda d: d.expires_at, default=None)
 

@@ -29,6 +29,8 @@ import threading
 import time
 from typing import Optional
 
+from deeplearning4j_tpu.metrics.registry import MetricsRegistry
+
 _HDR = struct.Struct(">cH")
 _LEN = struct.Struct(">I")
 
@@ -107,7 +109,8 @@ class StreamingBroker:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  subscriber_buffer: int = 16, drop_limit: int = 8,
-                 publish_patience_s: Optional[float] = 0.5):
+                 publish_patience_s: Optional[float] = 0.5,
+                 registry: Optional[MetricsRegistry] = None):
         self.host = host
         self.port = port
         self.subscriber_buffer = subscriber_buffer
@@ -118,9 +121,25 @@ class StreamingBroker:
         self._server: Optional[socket.socket] = None
         self._threads: list = []
         self._stop = threading.Event()
-        self._frames_dropped = 0
-        self._subs_disconnected = 0
-        self._dropped_by_topic: dict = {}
+        # fan-out health counters live in the registry (leaf-locked);
+        # broker _lock only guards subscriber bookkeeping
+        self.metrics = registry if registry is not None \
+            else MetricsRegistry()
+        self._m_frames_dropped = self.metrics.counter(
+            "broker_frames_dropped_total",
+            "frames dropped for slow subscribers")
+        self._m_subs_disconnected = self.metrics.counter(
+            "broker_subscribers_disconnected_total",
+            "slow-subscriber evictions")
+        self._m_dropped_by_topic = self.metrics.counter(
+            "broker_dropped_by_topic_total",
+            "frames dropped per topic", labels=("topic",))
+        self.metrics.gauge("broker_subscribers", "live subscribers",
+                           fn=self._subscriber_count)
+
+    def _subscriber_count(self) -> int:
+        with self._lock:
+            return sum(len(v) for v in self._subs.values())
 
     def _track(self, t: threading.Thread) -> None:
         """Retain ``t`` for lifecycle introspection, pruning finished
@@ -266,10 +285,9 @@ class StreamingBroker:
         with self._lock:
             s.dropped += 1
             s.consecutive_drops += 1
-            self._frames_dropped += 1
-            self._dropped_by_topic[s.topic] = (
-                self._dropped_by_topic.get(s.topic, 0) + 1)
             evict = s.consecutive_drops >= self.drop_limit
+        self._m_frames_dropped.inc()
+        self._m_dropped_by_topic.labels(topic=s.topic).inc()
         if evict:
             self._disconnect(s)
 
@@ -283,7 +301,7 @@ class StreamingBroker:
             ss = self._subs.get(s.topic, [])
             if s in ss:
                 ss.remove(s)
-            self._subs_disconnected += 1
+        self._m_subs_disconnected.inc()
         try:
             s.sock.close()
         except OSError:
@@ -292,14 +310,17 @@ class StreamingBroker:
     def stats(self) -> dict:
         """Fan-out health counters: live subscriber count, frames dropped
         for slow subscribers (total and per topic), and slow-subscriber
-        evictions."""
-        with self._lock:
-            return {
-                "subscribers": sum(len(v) for v in self._subs.values()),
-                "frames_dropped": self._frames_dropped,
-                "subscribers_disconnected": self._subs_disconnected,
-                "dropped_by_topic": dict(self._dropped_by_topic),
-            }
+        evictions. Counters come off the registry, so the snapshot is
+        assembled outside ``_lock``."""
+        return {
+            "subscribers": self._subscriber_count(),
+            "frames_dropped": int(self._m_frames_dropped.value),
+            "subscribers_disconnected":
+                int(self._m_subs_disconnected.value),
+            "dropped_by_topic": {
+                lbls["topic"]: int(m.value)
+                for lbls, m in self._m_dropped_by_topic.samples()},
+        }
 
 
 def main(argv=None):

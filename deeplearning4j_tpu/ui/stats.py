@@ -20,6 +20,7 @@ import time
 import jax
 import numpy as np
 
+from deeplearning4j_tpu.metrics.registry import global_registry
 from deeplearning4j_tpu.optimize.listeners import TrainingListener
 from deeplearning4j_tpu.ui.storage import StatsStorageRouter, make_record
 
@@ -68,7 +69,8 @@ def _rss_bytes() -> int:
 class StatsListener(TrainingListener):
     def __init__(self, router: StatsStorageRouter, session_id: str = None,
                  worker_id: str = "worker_0", reporting_frequency: int = 10,
-                 collect_histograms: bool = False, histogram_bins: int = 20):
+                 collect_histograms: bool = False, histogram_bins: int = 20,
+                 registry=None):
         self.router = router
         self.session_id = session_id or f"session_{int(time.time())}"
         self.worker_id = worker_id
@@ -80,6 +82,25 @@ class StatsListener(TrainingListener):
         self._last_time = None
         self._last_iter = None
         self._pending_phase_timings = None
+        # training telemetry also lands in the shared registry (default:
+        # process-global), so serving and training share one scrape
+        self.metrics = registry if registry is not None \
+            else global_registry()
+        self._m_score = self.metrics.gauge(
+            "training_score", "loss at the last reporting iteration",
+            labels=("worker",))
+        self._m_iteration = self.metrics.gauge(
+            "training_iteration", "last reported iteration",
+            labels=("worker",))
+        self._m_ips = self.metrics.gauge(
+            "training_iterations_per_second", "training throughput",
+            labels=("worker",))
+        self._m_rss = self.metrics.gauge(
+            "training_memory_rss_bytes", "host RSS at report time",
+            labels=("worker",))
+        self._m_report_ms = self.metrics.histogram(
+            "training_report_interval_ms",
+            "wall time between reporting iterations", labels=("worker",))
 
     # ------------------------------------------------------------------ hooks
     def on_epoch_start(self, model):
@@ -140,6 +161,16 @@ class StatsListener(TrainingListener):
             data["param_histograms"] = self._histograms(model.params)
         self.router.put_update(make_record(
             self.session_id, TYPE_ID, self.worker_id, data))
+        self._m_score.labels(worker=self.worker_id).set(data["score"])
+        self._m_iteration.labels(worker=self.worker_id).set(iteration)
+        self._m_rss.labels(worker=self.worker_id).set(
+            data["memory_rss_bytes"])
+        if data.get("iterations_per_second"):
+            self._m_ips.labels(worker=self.worker_id).set(
+                data["iterations_per_second"])
+        if "duration_ms" in data:
+            self._m_report_ms.labels(worker=self.worker_id).observe(
+                data["duration_ms"])
         self._last_params_norms = norms
         self._last_time = now
         self._last_iter = iteration

@@ -147,6 +147,11 @@ def pytest_configure(config):
         "runs in tier-1, deliberately NOT in the slow set)")
     config.addinivalue_line(
         "markers",
+        "metrics: observability tests (metrics registry, Prometheus "
+        "exposition, autoscaler, load harness — CPU-fast; runs in "
+        "tier-1, deliberately NOT in the slow set)")
+    config.addinivalue_line(
+        "markers",
         "allow_step_recompiles: opt out of the per-test train-step "
         "recompile-count guard")
     config.addinivalue_line(
@@ -170,7 +175,8 @@ def _lock_order_debug(request):
     if os.environ.get("DL4J_TPU_LOCK_DEBUG") != "1" or not (
             request.node.get_closest_marker("serving")
             or request.node.get_closest_marker("generation")
-            or request.node.get_closest_marker("fleet")):
+            or request.node.get_closest_marker("fleet")
+            or request.node.get_closest_marker("metrics")):
         yield
         return
     from deeplearning4j_tpu.analysis import instrument
