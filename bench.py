@@ -963,6 +963,167 @@ def bench_generate_longtail(slots: int = 8, vocab: int = 256,
     }
 
 
+def bench_quant_serve(slots: int = 16, vocab: int = 256,
+                      d_model: int = 256, n_blocks: int = 2,
+                      repeats: int = 2):
+    """Int8 paged KV-cache capacity at a FIXED page-byte budget: the same
+    budget buys a f32 pool and an int8 pool (values stored int8 with
+    per-token-per-head f32 dequant scales), so the int8 server fits
+    >= 1.8x the concurrent sequences — asserted from the real allocated
+    pools (``GenerationServer`` verifies its byte accounting against the
+    arrays XLA materialised), not from a formula. Both servers then run
+    the same greedy workload with INTERLEAVED timed passes (best pass
+    each, same shared-noisy-box rationale as ``generate_serve``), and
+    every int8 completion is gated on greedy agreement vs its f32
+    reference — the capacity win does not get to cost correctness.
+
+    Reports tokens/s for both pools, the capacity ratio, resident cache
+    bytes, and the mean greedy-agreement score."""
+    from deeplearning4j_tpu.models import TransformerLM
+    from deeplearning4j_tpu.parallel.generation import GenerationServer
+
+    page_size = 16
+    max_cache = 64
+    net = TransformerLM(num_labels=vocab, max_length=max_cache,
+                        d_model=d_model, n_heads=8, n_blocks=n_blocks,
+                        seed=0).init()
+    for v in net.conf.vertices.values():
+        lyr = getattr(v, "layer", None)
+        if lyr is not None and hasattr(lyr, "max_cache"):
+            lyr.max_cache = max_cache
+    rs = np.random.RandomState(13)
+    shapes = [(6, 26), (14, 18), (10, 22), (16, 16)]  # all span 2 pages
+    reqs = [(rs.randint(0, vocab, shapes[i % 4][0]), shapes[i % 4][1])
+            for i in range(2 * slots)]
+    n_tokens = sum(steps for _, steps in reqs)
+
+    # ONE byte budget, sized in f32 pages; each server converts it to
+    # pages at ITS bytes-per-token (+1 garbage page apiece)
+    f32_pages = 2 * slots + 1
+
+    def probe_tok_bytes(kv_dtype):
+        probe = GenerationServer(net, vocab, slots=1,
+                                 page_size=page_size, pages=2,
+                                 kv_dtype=kv_dtype)
+        try:
+            return probe._page_token_bytes
+        finally:
+            probe.close()
+
+    f32_tok = probe_tok_bytes(None)
+    int8_tok = probe_tok_bytes("int8")
+    budget_bytes = f32_pages * page_size * f32_tok
+    pages = {None: f32_pages,
+             "int8": budget_bytes // (page_size * int8_tok)}
+    capacity_ratio = pages["int8"] / pages[None]
+    if capacity_ratio < 1.8:
+        raise RuntimeError(
+            f"int8 KV pool fits only {capacity_ratio:.2f}x the f32 "
+            "sequences at the same byte budget — below the 1.8x bar "
+            "the per-page scale planes were budgeted for")
+
+    results = {}
+    refs = None
+    for kv_dtype in (None, "int8"):
+        srv = GenerationServer(net, vocab, slots=slots,
+                               page_size=page_size,
+                               pages=int(pages[kv_dtype]),
+                               steps_per_dispatch=8,
+                               max_pending=2 * len(reqs),
+                               kv_dtype=kv_dtype)
+        try:
+            st0 = srv.stats()  # also asserts page-byte accounting
+            assert st0["pages"]["bytes_per_token"] * page_size \
+                * st0["pages"]["pages_total"] <= budget_bytes + \
+                page_size * f32_tok, "pool exceeds the byte budget"
+            for f in [srv.submit(p, 2) for p, _ in reqs[:2]]:
+                f.result(timeout=SUB_BENCH_TIMEOUT_S)
+            best = float("inf")
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                futs = [srv.submit(p, s) for p, s in reqs]
+                outs = [f.result(timeout=SUB_BENCH_TIMEOUT_S)
+                        for f in futs]
+                best = min(best, time.perf_counter() - t0)
+            st = srv.stats()
+        finally:
+            srv.close()
+        if kv_dtype is None:
+            refs = outs
+        results[kv_dtype] = (best, outs, st)
+
+    from deeplearning4j_tpu.optimize.quantize import greedy_agreement
+    agreements = [greedy_agreement(got, ref)
+                  for got, ref in zip(results["int8"][1], refs)]
+    mean_agree = float(np.mean(agreements))
+    if mean_agree < 0.95:
+        raise RuntimeError(
+            f"int8 KV greedy agreement {mean_agree:.3f} vs f32 — the "
+            "capacity win is not allowed to corrupt decoding")
+    f32_s, _, st_f = results[None]
+    int8_s, _, st_q = results["int8"]
+    return {
+        "quant_serve_kv_capacity_x": capacity_ratio,
+        "quant_serve_f32_tokens_s": _sane("quant_serve_f32_tokens_s",
+                                          n_tokens / f32_s),
+        "quant_serve_tokens_s": _sane("quant_serve_tokens_s",
+                                      n_tokens / int8_s),
+        "quant_serve_greedy_agreement": mean_agree,
+        "quant_serve_kv_bytes_per_token": float(
+            st_q["pages"]["bytes_per_token"]),
+        "quant_serve_f32_kv_bytes_per_token": float(
+            st_f["pages"]["bytes_per_token"]),
+        "quant_serve_peak_resident_kv_bytes": float(
+            st_q["pages"]["peak_resident_kv_bytes"]),
+    }
+
+
+def bench_quant_infer(n_requests: int = 256, max_batch: int = 64,
+                      max_wait_ms: float = 2.0):
+    """Int8-weight serving throughput: the ``inference_serve`` workload
+    through ``ParallelInference(quantize="int8")`` — absmax per-channel
+    int8 LeNet weights with the dequant fused into each matmul/conv —
+    next to the f32 server, same coalescer settings. Gated on eval
+    parity: the two servers' argmax decisions over the whole workload
+    must agree on >= 99% of rows (random-weight LeNet logit gaps are
+    tight, so this is a strict bound). Reports req/s for both paths."""
+    from deeplearning4j_tpu.models import LeNet
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+    rs = np.random.RandomState(3)
+    xs = rs.randn(n_requests, 1, 28, 28, 1).astype(np.float32)
+    net = LeNet(num_labels=10).init()
+
+    def run(quantize):
+        with ParallelInference(net, max_batch=max_batch,
+                               max_wait_ms=max_wait_ms,
+                               max_pending=2 * n_requests,
+                               quantize=quantize) as inf:
+            inf.submit(xs[0]).result(timeout=120)
+            inf.output(xs[:max_batch, 0])
+            t0 = time.perf_counter()
+            futs = [inf.submit(xs[i]) for i in range(n_requests)]
+            rows = [f.result(timeout=120) for f in futs]
+            total = time.perf_counter() - t0
+        return total, np.concatenate([np.asarray(r) for r in rows])
+
+    f32_s, f32_out = run(None)
+    int8_s, int8_out = run("int8")
+    agree = float((f32_out.argmax(-1) == int8_out.argmax(-1)).mean())
+    if agree < 0.99:
+        raise RuntimeError(
+            f"int8-weight serving argmax agreement {agree:.3f} vs f32 "
+            "— per-channel weight quantization should not move LeNet "
+            "decisions at this rate")
+    return {
+        "quant_infer_f32_req_s": _sane("quant_infer_f32_req_s",
+                                       n_requests / f32_s),
+        "quant_infer_req_s": _sane("quant_infer_req_s",
+                                   n_requests / int8_s),
+        "quant_infer_argmax_agreement": agree,
+    }
+
+
 def bench_serve_soak(duration_s: float = 8.0, lo: float = 1200.0,
                      hi: float = 1550.0, ramp_s: float = 3.0,
                      spike_add: float = 500.0, spike_at: float = 4.5,
@@ -1296,6 +1457,10 @@ SANITY_CEILING = {
     "generate_serve_tokens_s": 1e9,
     "generate_serve_serial_tokens_s": 1e9,
     "generate_longtail_tokens_s": 1e9,
+    "quant_serve_tokens_s": 1e9,
+    "quant_serve_f32_tokens_s": 1e9,
+    "quant_infer_req_s": 1e8,
+    "quant_infer_f32_req_s": 1e8,
     "vgg16_bf16_img_s": 1e5,
     "textgen_lstm_tokens_s": 1e9,
     "transformer_lm_tokens_s": 1e9,
@@ -1372,6 +1537,16 @@ METRIC_UNIT = {
     "generate_longtail_prefix_hits": "hits",
     "generate_longtail_prefix_tokens_reused": "tokens",
     "generate_longtail_cow_copies": "copies",
+    "quant_serve_kv_capacity_x": "x",
+    "quant_serve_tokens_s": "tokens/s",
+    "quant_serve_f32_tokens_s": "tokens/s",
+    "quant_serve_greedy_agreement": "",
+    "quant_serve_kv_bytes_per_token": "B",
+    "quant_serve_f32_kv_bytes_per_token": "B",
+    "quant_serve_peak_resident_kv_bytes": "B",
+    "quant_infer_req_s": "req/s",
+    "quant_infer_f32_req_s": "req/s",
+    "quant_infer_argmax_agreement": "",
     "vgg16_bf16_img_s": "img/s",
     "textgen_lstm_tokens_s": "tokens/s",
     "transformer_lm_tokens_s": "tokens/s",
@@ -1601,7 +1776,8 @@ def main():
              "word2vec", "doc2vec", "attention", "fit_e2e", "eval_e2e",
              "guard_overhead", "metrics_overhead", "inference_serve",
              "serve_chaos", "serve_fleet", "serve_soak",
-             "generate_serve", "generate_longtail")
+             "generate_serve", "generate_longtail", "quant_serve",
+             "quant_infer")
     if which not in valid:
         sys.exit(f"Unknown model '{which}'; choose one of {valid}")
     # persistent XLA compile cache: repeated bench runs skip the
@@ -1666,6 +1842,11 @@ def main():
     if which in ("all", "generate_longtail"):
         _sub_metric(extras, "generate_longtail", bench_generate_longtail)
         headline and headline.sample("post-generate-serve")
+    if which in ("all", "quant_serve"):
+        _sub_metric(extras, "quant_serve", bench_quant_serve)
+    if which in ("all", "quant_infer"):
+        _sub_metric(extras, "quant_infer", bench_quant_infer)
+        headline and headline.sample("post-quant")
     if which in ("all", "vgg16"):
         _sub_metric(extras, "vgg16_bf16_img_s", bench_vgg16, digits=2)
         if extras.get("vgg16_bf16_img_s"):

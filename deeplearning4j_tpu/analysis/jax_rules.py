@@ -53,6 +53,8 @@ HOT_FUNCTIONS = {
     "_autoscale_tick",                            # autoscaler control loop
     "_soak_arrival_loop",                         # load-generator pacing
     "_snapshot_families",                         # /metrics scrape path
+    "_proj",                                      # fused-dequant projection
+    "_quantize_kv",                               # int8 KV write quantizer
 }
 
 SYNC_BUILTINS = {"float", "bool", "int"}

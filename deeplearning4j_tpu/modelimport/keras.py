@@ -168,13 +168,14 @@ class KerasModelImport:
     """reference: KerasModelImport.java entry points."""
 
     @staticmethod
-    def import_keras_sequential_model_and_weights(path: str
-                                                  ) -> MultiLayerNetwork:
-        return import_keras_sequential_model_and_weights(path)
+    def import_keras_sequential_model_and_weights(
+            path: str, quantize=None) -> MultiLayerNetwork:
+        return import_keras_sequential_model_and_weights(
+            path, quantize=quantize)
 
     @staticmethod
-    def import_keras_model_and_weights(path: str):
-        return import_keras_model_and_weights(path)
+    def import_keras_model_and_weights(path: str, quantize=None):
+        return import_keras_model_and_weights(path, quantize=quantize)
 
 
 def _model_config(f) -> dict:
@@ -187,19 +188,25 @@ def _model_config(f) -> dict:
     return json.loads(raw)
 
 
-def import_keras_model_and_weights(path: str):
+def import_keras_model_and_weights(path: str, quantize=None):
     """Functional or Sequential model import. Sequential (and LINEAR
     functional) models become a MultiLayerNetwork; BRANCHED functional
     DAGs (residual adds, concat merges — the zoo-class models) become a
     ComputationGraph (reference: KerasModel.java:419-495 builds a
     ComputationGraphConfiguration.GraphBuilder; merge layers via
-    layers/KerasMerge.java)."""
+    layers/KerasMerge.java).
+
+    ``quantize="int8"`` rewrites the imported weights to absmax
+    per-channel int8 (optimize/quantize.py) before returning — the
+    imported-then-quantized net serves through the same fused-dequant
+    path as a quantized zoo model."""
     import h5py
 
     with h5py.File(path, "r") as f:
         config = _model_config(f)
     if config["class_name"] == "Sequential":
-        return import_keras_sequential_model_and_weights(path)
+        return import_keras_sequential_model_and_weights(
+            path, quantize=quantize)
     cfg = config["config"]
     layers = cfg["layers"] if isinstance(cfg, dict) else cfg
     n_outputs = (len(_layer_refs(cfg.get("output_layers", [])))
@@ -212,8 +219,15 @@ def import_keras_model_and_weights(path: str):
         # contributes no layer but carries the input shape (Keras 3 puts
         # batch_shape only there)
         fake = {"class_name": "Sequential", "config": list(layers)}
-        return _import_sequential(path, fake)
-    return _import_functional(path, config)
+        return _maybe_quantize(_import_sequential(path, fake), quantize)
+    return _maybe_quantize(_import_functional(path, config), quantize)
+
+
+def _maybe_quantize(net, quantize):
+    if quantize is None:
+        return net
+    from deeplearning4j_tpu.optimize.quantize import quantize_net
+    return quantize_net(net, quantize)
 
 
 def _inbound_names(layer: dict):
@@ -439,7 +453,8 @@ def _import_functional(path: str, config: dict):
     return net
 
 
-def import_keras_sequential_model_and_weights(path: str) -> MultiLayerNetwork:
+def import_keras_sequential_model_and_weights(
+        path: str, quantize=None) -> MultiLayerNetwork:
     import h5py
 
     with h5py.File(path, "r") as f:
@@ -447,7 +462,7 @@ def import_keras_sequential_model_and_weights(path: str) -> MultiLayerNetwork:
     if config["class_name"] != "Sequential":
         raise ValueError("Not a Sequential model; use "
                          "import_keras_model_and_weights")
-    return _import_sequential(path, config)
+    return _maybe_quantize(_import_sequential(path, config), quantize)
 
 
 def _import_sequential(path: str, config: dict) -> MultiLayerNetwork:

@@ -70,11 +70,16 @@ class ZooModel:
 
     def __init__(self, num_labels: int = 1000, seed: int = 123,
                  input_shape: Optional[tuple] = None, dtype: str = "float32",
-                 compute_dtype: Optional[str] = None):
+                 compute_dtype: Optional[str] = None,
+                 quantize: Optional[str] = None):
         self.num_labels = num_labels
         self.seed = seed
         self.dtype = dtype
         self.compute_dtype = compute_dtype
+        #: "int8" quantizes the initialized net's dense/conv/attention
+        #: weights in place at init() (optimize/quantize.py); None (the
+        #: default) keeps full-precision params bit-exact
+        self.quantize = quantize
         if input_shape is not None:
             self.input_shape = tuple(input_shape)
 
@@ -87,7 +92,11 @@ class ZooModel:
         net = (ComputationGraph(c)
                if type(c).__name__ == "ComputationGraphConfiguration"
                else MultiLayerNetwork(c))
-        return net.init()
+        net = net.init()
+        if self.quantize is not None:
+            from deeplearning4j_tpu.optimize.quantize import quantize_net
+            net = quantize_net(net, self.quantize)
+        return net
 
     def init_pretrained(self, pretrained_type: str = "imagenet"):
         raise NotImplementedError(

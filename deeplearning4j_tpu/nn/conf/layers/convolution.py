@@ -95,6 +95,7 @@ class ConvolutionLayer(BaseLayer):
 
     INPUT_KIND = "cnn"
     DEFAULT_ACTIVATION = "identity"
+    QUANT_PARAMS = ("W",)
 
     def __post_init__(self):
         self.kernel_size = _pair(self.kernel_size)
@@ -133,12 +134,19 @@ class ConvolutionLayer(BaseLayer):
         return {"W": W, "b": b}
 
     def preactivate(self, params, x):
+        # int8-quantized kernels (optimize/quantize.py) carry a W_scale
+        # sibling: widen on the fly and fold the per-output-channel
+        # dequant into the conv epilogue ([c_out] broadcasts over NHWC)
+        scale = params.get("W_scale")
+        W = params["W"] if scale is None else params["W"].astype(x.dtype)
         out = lax.conv_general_dilated(
-            x, params["W"],
+            x, W,
             window_strides=self.stride,
             padding=_conv_padding(self.convolution_mode, self.padding),
             rhs_dilation=self.dilation,
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if scale is not None:
+            out = (out * scale).astype(x.dtype)
         return out + params["b"]
 
     def forward(self, params, state, x, *, mask=None, train=False, rng=None):
@@ -178,9 +186,13 @@ class Deconvolution2D(ConvolutionLayer):
             ph, pw = self.padding
             kh, kw = self.kernel_size
             padding = [(kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw)]
+        scale = params.get("W_scale")
+        W = params["W"] if scale is None else params["W"].astype(x.dtype)
         out = lax.conv_transpose(
-            x, params["W"], strides=self.stride, padding=padding,
+            x, W, strides=self.stride, padding=padding,
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if scale is not None:
+            out = (out * scale).astype(x.dtype)
         return out + params["b"]
 
 
@@ -190,6 +202,8 @@ class SeparableConvolution2D(ConvolutionLayer):
     """Depthwise + pointwise convolution."""
 
     depth_multiplier: int = 1
+
+    QUANT_PARAMS = ("dW", "pW")
 
     def param_order(self):
         return ["dW", "pW", "b"]
@@ -205,14 +219,24 @@ class SeparableConvolution2D(ConvolutionLayer):
         return {"dW": dW, "pW": pW, "b": b}
 
     def preactivate(self, params, x):
+        # per-channel dequant does not commute through the pointwise
+        # mix, so each stage applies its own scale right after its conv
+        dscale = params.get("dW_scale")
+        dW = params["dW"] if dscale is None else params["dW"].astype(x.dtype)
         depthwise = lax.conv_general_dilated(
-            x, params["dW"], window_strides=self.stride,
+            x, dW, window_strides=self.stride,
             padding=_conv_padding(self.convolution_mode, self.padding),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             feature_group_count=self.n_in)
+        if dscale is not None:
+            depthwise = (depthwise * dscale).astype(x.dtype)
+        pscale = params.get("pW_scale")
+        pW = params["pW"] if pscale is None else params["pW"].astype(x.dtype)
         pointwise = lax.conv_general_dilated(
-            depthwise, params["pW"], window_strides=(1, 1), padding="VALID",
+            depthwise, pW, window_strides=(1, 1), padding="VALID",
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if pscale is not None:
+            pointwise = (pointwise * pscale).astype(x.dtype)
         return pointwise + params["b"]
 
 

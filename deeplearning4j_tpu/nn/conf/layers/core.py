@@ -24,7 +24,16 @@ from deeplearning4j_tpu.utils.serde import register_serializable
 @register_serializable
 @dataclass
 class DenseLayer(FeedForwardLayer):
-    """Fully-connected layer: activation(x @ W + b). W: [n_in, n_out]."""
+    """Fully-connected layer: activation(x @ W + b). W: [n_in, n_out].
+
+    Serves int8-quantized weights when the params tree carries a
+    ``W_scale`` sibling (optimize/quantize.py): the dequant is fused
+    into the matmul epilogue — ``(x @ W_q.astype(x)) * scale`` — so W
+    stays int8 in memory. Presence of the scale is a pytree-STRUCTURE
+    property, i.e. part of the jit cache key: f32 and int8 param trees
+    each trace their own program, and the f32 path is untouched."""
+
+    QUANT_PARAMS = ("W",)
 
     def param_order(self):
         return ["W", "b"]
@@ -36,7 +45,11 @@ class DenseLayer(FeedForwardLayer):
         return {"W": W, "b": b}
 
     def preactivate(self, params, x):
-        return jnp.dot(x, params["W"]) + params["b"]
+        scale = params.get("W_scale")
+        if scale is None:
+            return jnp.dot(x, params["W"]) + params["b"]
+        out = jnp.dot(x, params["W"].astype(x.dtype)) * scale
+        return out.astype(x.dtype) + params["b"]
 
     def forward(self, params, state, x, *, mask=None, train=False, rng=None):
         x = self.apply_input_dropout(x, train=train, rng=rng)

@@ -35,7 +35,8 @@ from deeplearning4j_tpu.optimize.bucketing import (BoundedCache, bucket_rows,
 from deeplearning4j_tpu.utils.pytree import flatten_params, unflatten_params
 
 _RNN_KEYS = ("h", "c", "kcache", "vcache", "cache_pos",
-             "kpages", "vpages", "block_table")
+             "kpages", "vpages", "block_table",
+             "kscale", "vscale", "kscales", "vscales")
 
 
 def _split_state(state):
@@ -46,7 +47,9 @@ def _split_state(state):
     PositionalEncodingLayer incremental decode) — present only when a
     streaming carry was seeded by rnn_time_step, never during training.
     kpages/vpages/block_table: the paged-pool variant of the same carry
-    (GenerationServer's block-table serving path)."""
+    (GenerationServer's block-table serving path). kscale(s)/vscale(s):
+    the per-token dequant planes riding an int8 KV-cache — carry, for
+    the same reason the caches they describe are."""
     persistent, carry = {}, {}
     for k, v in state.items():
         (carry if k in _RNN_KEYS else persistent)[k] = v

@@ -14,3 +14,9 @@ from deeplearning4j_tpu.optimize.health import (
     DivergenceError,
     HealthPolicy,
 )
+from deeplearning4j_tpu.optimize.quantize import (
+    confusion_delta,
+    greedy_agreement,
+    quantize_net,
+    quantize_params,
+)

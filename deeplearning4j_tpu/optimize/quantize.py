@@ -1,0 +1,166 @@
+"""Post-training int8 quantization of inference params.
+
+The reference framework's accelerator story is a swappable compute
+backend under an unchanged layer API (the cuDNN ``*Helper`` pattern —
+ConvolutionLayer.java:68-79 loads an accelerated implementation by
+reflection and the f32 layer contract never moves). The JAX-native
+equivalent built here is a quantized EXECUTION PATH behind the same
+``output()``/``submit()`` surfaces:
+
+- ``quantize_params(net) -> (qparams, scales)`` rewrites the dense /
+  conv / attention-projection weights to absmax per-output-channel int8
+  (``scale = absmax / 127`` over every axis but the last), leaving
+  biases, norms, embeddings and recurrent cells untouched. The int8
+  tensor and its ``"<name>_scale"`` sibling ride the SAME params pytree,
+  so every existing jit program keyed on params structure simply
+  retraces once for the quantized tree — no new program plumbing.
+- Layers detect quantization at TRACE time (``"W_scale" in params`` is a
+  pytree-structure check, part of the jit cache key) and fuse the
+  dequant into the matmul/conv: ``(x @ W_q.astype(x)) * scale``, which
+  XLA folds into the epilogue of the GEMM — the weights stay int8 in
+  memory, 4x smaller, and are widened on the fly.
+- ``quantize_net(net)`` returns a servable shallow copy whose params are
+  quantized; the source net is untouched, so an f32 fleet can A/B a
+  quantized replica against bit-exact originals.
+
+Quantized outputs are NOT bit-exact vs f32 — they are gated on bounded
+eval deltas instead: ``confusion_delta`` (fraction of examples that
+moved confusion-matrix cells between two ``Evaluation``s) and
+``greedy_agreement`` (fraction of positions two greedy completions
+agree on). tests/test_quantize.py and the ``quant_serve`` bench assert
+those gates; everything with quantization OFF stays bit-exact.
+"""
+
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+
+#: suffix marking a dequant scale riding next to its int8 tensor in the
+#: params tree — layers key the fused-dequant path on its presence
+SCALE_SUFFIX = "_scale"
+
+
+def quantize_array(w):
+    """Absmax per-output-channel int8: ``(q, scale)`` with the scale
+    reduced over every axis but the LAST (the output-channel axis for
+    all quantizable layouts here: ``[in, out]`` dense, ``[kh, kw, in,
+    out]`` HWIO conv, ``[d_model, d_model]`` attention projections).
+
+    ``q * scale`` reconstructs ``w`` to within half a quantization step
+    per channel. All-zero channels get scale 0 (and reconstruct as
+    exact zeros). Runs on host numpy — quantization is a one-shot
+    model-load transform, not a traced op."""
+    import jax.numpy as jnp
+
+    w = np.asarray(w)
+    absmax = np.max(np.abs(w), axis=tuple(range(w.ndim - 1))) \
+        if w.ndim > 1 else np.abs(w)
+    scale = (absmax / 127.0).astype(np.float32)
+    safe = np.where(scale > 0, scale, 1.0)
+    q = np.clip(np.round(w / safe), -127, 127).astype(np.int8)
+    return jnp.asarray(q), jnp.asarray(scale)
+
+
+def dequantize_array(q, scale, dtype=np.float32):
+    """Reconstruct ``q * scale`` (the reference the fused path folds
+    into its matmul epilogue) — for tests and round-trip bounds."""
+    return (np.asarray(q).astype(np.float32) * np.asarray(scale)).astype(
+        dtype)
+
+
+def _layer_items(net):
+    """(params key, layer) pairs keyed exactly as ``net.params`` —
+    positional ``str(i)`` for MultiLayerNetwork, vertex name for
+    ComputationGraph (same vocabulary as ``_stream_layers``)."""
+    if hasattr(net, "layers"):
+        for i, layer in enumerate(net.layers):
+            yield str(i), layer
+    else:
+        for name, v in net.conf.vertices.items():
+            layer = getattr(v, "layer", None)
+            if layer is not None:
+                yield name, layer
+
+
+def quantize_params(net):
+    """Quantize every ``QUANT_PARAMS`` weight of ``net`` (dense W, conv
+    kernels, attention Wq/Wk/Wv/Wo — layers opt in via the class
+    attribute, so embeddings / norms / recurrent cells never quantize).
+
+    Returns ``(qparams, scales)``: ``qparams`` is directly servable —
+    the original params tree with each quantized tensor replaced by its
+    int8 form plus a ``"<name>_scale"`` sibling — and ``scales`` is a
+    plain ``{layer_key: {param_name: scale}}`` side dict for
+    inspection."""
+    qparams = {k: dict(v) if isinstance(v, dict) else v
+               for k, v in net.params.items()}
+    scales: dict = {}
+    for key, layer in _layer_items(net):
+        names = getattr(layer, "QUANT_PARAMS", ())
+        lp = qparams.get(key)
+        if not names or not isinstance(lp, dict):
+            continue
+        for pname in names:
+            w = lp.get(pname)
+            if w is None:
+                continue
+            q, scale = quantize_array(w)
+            lp[pname] = q
+            lp[pname + SCALE_SUFFIX] = scale
+            scales.setdefault(key, {})[pname] = scale
+    return qparams, scales
+
+
+def quantize_net(net, mode: str = "int8"):
+    """A servable copy of ``net`` with int8-quantized weights.
+
+    The copy is shallow: conf, state and the compiled-program caches are
+    shared (programs take params as jit ARGUMENTS, so the quantized
+    pytree structure retraces exactly once per program family and both
+    nets keep their own correct math). The source net's params are
+    untouched — its outputs stay bit-exact. Inference-only: fitting a
+    quantized net would try to take gradients through int8 weights."""
+    if mode != "int8":
+        raise ValueError(f"unsupported quantization mode {mode!r} "
+                         "(only 'int8')")
+    qparams, _ = quantize_params(net)
+    qnet = copy.copy(net)
+    qnet.params = qparams
+    return qnet
+
+
+# ------------------------------------------------------- accuracy gates
+def confusion_delta(ev_a, ev_b) -> float:
+    """Fraction of evaluated examples that changed confusion-matrix
+    cells between two ``Evaluation`` results (0.0 = identical
+    classifications). The eval-parity gate for quantized weights."""
+    cm_a = ev_a.confusion if hasattr(ev_a, "confusion") else ev_a
+    cm_b = ev_b.confusion if hasattr(ev_b, "confusion") else ev_b
+    cm_a = np.zeros((1, 1), np.int64) if cm_a is None else np.asarray(cm_a)
+    cm_b = np.zeros((1, 1), np.int64) if cm_b is None else np.asarray(cm_b)
+    if cm_a.shape != cm_b.shape:
+        raise ValueError(f"confusion shapes differ: {cm_a.shape} vs "
+                         f"{cm_b.shape}")
+    n = cm_a.sum()
+    if n != cm_b.sum():
+        raise ValueError("evaluations cover different example counts: "
+                         f"{n} vs {cm_b.sum()}")
+    if n == 0:
+        return 0.0
+    # each moved example leaves one cell and enters another
+    return float(np.abs(cm_a - cm_b).sum()) / (2.0 * float(n))
+
+
+def greedy_agreement(a, b) -> float:
+    """Fraction of aligned positions where two greedy completions pick
+    the same token (length mismatch counts the missing tail as
+    disagreement). The generation gate for int8 KV-caches."""
+    a = np.asarray(a).ravel()
+    b = np.asarray(b).ravel()
+    n = min(a.size, b.size)
+    hi = max(a.size, b.size)
+    if hi == 0:
+        return 1.0
+    return float(np.count_nonzero(a[:n] == b[:n])) / float(hi)

@@ -190,6 +190,15 @@ class GenerationServer:
     prefill — long prompts advance through several bounded dispatches
     instead of one huge one, without changing any output bit).
 
+    ``kv_dtype="int8"`` stores the page pool int8 with per-page-row f32
+    scales (attention quantizes on write, dequantizes on gather): a
+    resident token costs ``2*H*d + 8*H`` bytes instead of
+    ``2*H*d*itemsize`` — ~3.5x more tokens per HBM byte at f32 — at the
+    price of a bounded greedy-agreement delta instead of bit-exactness
+    (the default ``None`` keeps the conf dtype and stays bit-exact).
+    COW page copies and the prefix cache carry the scale planes with
+    the values, so sharing semantics are unchanged.
+
     Speculative decoding: pass a small ``draft_net`` (same vocab, its own
     weights, ``max_cache >= `` the target's) and ``spec_k >= 2``; each
     round the draft proposes ``spec_k - 1`` tokens and the target
@@ -207,6 +216,7 @@ class GenerationServer:
                  pages: Optional[int] = None,
                  prefix_cache: bool = True,
                  steps_per_dispatch: int = 4,
+                 kv_dtype: Optional[str] = None,
                  draft_net=None,
                  spec_k: int = 4,
                  retry: Optional[RetryPolicy] = None,
@@ -230,8 +240,13 @@ class GenerationServer:
         self.request_deadline_s = request_deadline_s
         self.min_prefill_bucket = int(min_prefill_bucket)
         self.prefill_chunk = int(prefill_chunk)
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(f"unsupported kv_dtype {kv_dtype!r} "
+                             "(None or 'int8')")
         self.prefix_cache = bool(prefix_cache)
         self.steps_per_dispatch = int(steps_per_dispatch)
+        self.kv_dtype = kv_dtype
+        self._kv_quant = kv_dtype == "int8"
         self.spec_k = int(spec_k)
         self.admission = AdmissionController(max_pending)
         self.retry = retry if retry is not None else RetryPolicy()
@@ -366,6 +381,27 @@ class GenerationServer:
                 fn=lambda: len(self._page_pool.cache))
         m.gauge("generation_resident_kv_bytes", "bytes of resident KV",
                 fn=lambda: self._page_pool.in_use() * self._page_bytes)
+        # KV-residency telemetry on the Prometheus surface, not just
+        # /stats: total/in-use/shared occupancy, the high-water mark,
+        # and the cache geometry (bytes/token + int8 flag)
+        m.gauge("generation_pages_total", "KV page-pool size "
+                "(incl. the reserved garbage page)",
+                fn=lambda: self.pages_total)
+        m.gauge("generation_pages_in_use",
+                "pages holding live data (refcounted or prefix-cached)",
+                fn=lambda: self._page_pool.in_use())
+        m.gauge("generation_pages_shared",
+                "pages refcounted by more than one slot",
+                fn=lambda: self._page_pool.shared_count())
+        m.gauge("generation_peak_resident_kv_bytes",
+                "high-water resident KV bytes",
+                fn=lambda: self._page_pool.peak * self._page_bytes)
+        m.gauge("generation_kv_bytes_per_token",
+                "bytes per resident KV token (values + dequant scales)",
+                fn=lambda: self._page_token_bytes)
+        m.gauge("generation_kv_cache_int8",
+                "1 when pages store int8 (+f32 scales), 0 for conf dtype",
+                fn=lambda: 1.0 if self._kv_quant else 0.0)
 
         self._pool = self._fresh_pool()
         self._dpool = None if draft_net is None else self._fresh_draft_pool()
@@ -416,7 +452,16 @@ class GenerationServer:
         self._pos_names: list = []
         self._layer_by_name: dict = {}
         self._page_token_bytes = 0
-        itemsize = np.dtype(net.conf.dtype).itemsize
+        # admission accounting must track the CACHE dtype, not the conf
+        # dtype: int8 pages store 1-byte values plus one f32 scale per
+        # token per head for K and V each (the _fresh_pool allocation
+        # cross-checks this against the real array bytes)
+        if self._kv_quant:
+            kv_itemsize = 1
+            scale_bytes = np.dtype(np.float32).itemsize
+        else:
+            kv_itemsize = np.dtype(net.conf.dtype).itemsize
+            scale_bytes = 0
         for name, layer in net._stream_layers():
             c = probe.get(name)
             if not c:
@@ -425,8 +470,8 @@ class GenerationServer:
             if "kcache" in c and hasattr(layer, "init_paged_carry"):
                 self._paged_names.append(name)
                 h = layer.n_heads
-                self._page_token_bytes += 2 * h * (layer.n_out // h) \
-                    * itemsize
+                self._page_token_bytes += 2 * h * (
+                    (layer.n_out // h) * kv_itemsize + scale_bytes)
             elif "cache_pos" in c and "kcache" not in c:
                 self._pos_names.append(name)
             else:
@@ -477,16 +522,28 @@ class GenerationServer:
     # ----------------------------------------------------------- programs
     def _fresh_pool(self):
         """The donated device carry: one [pages, H, page_size, d] K/V
-        pool per attention layer. Positions and block tables are HOST
-        state threaded in per dispatch, so this is all the device
-        keeps."""
+        pool per attention layer (plus [pages, H, page_size] f32 scale
+        planes under ``kv_dtype="int8"``). Positions and block tables
+        are HOST state threaded in per dispatch, so this is all the
+        device keeps. The admission bookkeeping's bytes-per-page is
+        cross-checked against the REAL allocated array bytes here — the
+        two accounting paths are not allowed to diverge."""
         import jax
         import jax.numpy as jnp
 
         dtype = jnp.dtype(self.net.conf.dtype)
         pool = {name: self._layer_by_name[name].init_paged_carry(
-            self.pages_total, self._ps, dtype)
+            self.pages_total, self._ps, dtype, kv_dtype=self.kv_dtype)
             for name in self._paged_names}
+        nbytes = sum(int(leaf.nbytes)
+                     for leaf in jax.tree_util.tree_leaves(pool))
+        self._page_bytes_actual = nbytes // self.pages_total
+        if self._page_bytes_actual != self._page_bytes:
+            raise AssertionError(
+                f"KV admission accounting diverged from the allocated "
+                f"pool: {self._page_bytes} bytes/page expected from the "
+                f"conf, {self._page_bytes_actual} allocated "
+                f"(kv_dtype={self.kv_dtype!r})")
         return jax.device_put(pool)
 
     def _fresh_draft_pool(self):
@@ -539,7 +596,8 @@ class GenerationServer:
         m_steps = self.steps_per_dispatch
         paged = tuple(self._paged_names)
         pos_only = tuple(self._pos_names)
-        key = ("gen_decode", self.slots, vocab, m_steps)
+        quant = self._kv_quant
+        key = ("gen_decode", self.slots, vocab, m_steps, self.kv_dtype)
 
         def build():
             fwd = lm_stream_forward(net)
@@ -551,11 +609,23 @@ class GenerationServer:
                     S, pages.shape[1], NP * pages.shape[2],
                     pages.shape[3])
 
+            def gather_s(planes, bt):
+                # scale planes [P, H, ps] -> dense [S, H, NP*ps] strips
+                S, NP = bt.shape
+                return planes[bt].transpose(0, 2, 1, 3).reshape(
+                    S, planes.shape[1], NP * planes.shape[2])
+
             def step(params, state, pool, bt, positions, last, active,
                      temp, topk, base_keys, counts):
-                views = {vn: (gather(pool[vn]["kpages"], bt),
-                              gather(pool[vn]["vpages"], bt))
+                views = {vn: {"kcache": gather(pool[vn]["kpages"], bt),
+                              "vcache": gather(pool[vn]["vpages"], bt)}
                          for vn in paged}
+                if quant:
+                    for vn in paged:
+                        views[vn]["kscale"] = gather_s(
+                            pool[vn]["kscales"], bt)
+                        views[vn]["vscale"] = gather_s(
+                            pool[vn]["vscales"], bt)
                 first = next(iter(paged))
                 ps = pool[first]["kpages"].shape[2]
                 cap = bt.shape[1] * ps
@@ -569,12 +639,11 @@ class GenerationServer:
                     for vn in pos_only:
                         carry[vn] = {"cache_pos": posw}
                     for vn in paged:
-                        carry[vn] = {"kcache": views[vn][0],
-                                     "vcache": views[vn][1],
-                                     "cache_pos": posw}
+                        carry[vn] = dict(views[vn])
+                        carry[vn]["cache_pos"] = posw
                     x = jax.nn.one_hot(cur, vocab, dtype=dtype)[:, None, :]
                     out, nc = fwd(params, state, x, carry)
-                    views = {vn: (nc[vn]["kcache"], nc[vn]["vcache"])
+                    views = {vn: {k: nc[vn][k] for k in views[vn]}
                              for vn in paged}
                     # scatter the column this step wrote into its page:
                     # in-place inside the donated scan. Frozen/inactive
@@ -585,15 +654,28 @@ class GenerationServer:
                     pg = jnp.where(act, pg, 0)
                     off = posw % ps
                     cidx = posw[:, None, None, None]
+                    sidx = posw[:, None, None]
                     for vn in paged:
-                        kc, vc = views[vn]
+                        kc, vc = views[vn]["kcache"], views[vn]["vcache"]
                         kcol = jnp.take_along_axis(kc, cidx, axis=2)
                         vcol = jnp.take_along_axis(vc, cidx, axis=2)
-                        pool[vn] = {
+                        new = {
                             "kpages": pool[vn]["kpages"].at[
                                 pg, :, off, :].set(kcol[:, :, 0, :]),
                             "vpages": pool[vn]["vpages"].at[
                                 pg, :, off, :].set(vcol[:, :, 0, :])}
+                        if quant:
+                            # the written column's dequant scales ride
+                            # into the pool through the same routing
+                            kscol = jnp.take_along_axis(
+                                views[vn]["kscale"], sidx, axis=2)
+                            vscol = jnp.take_along_axis(
+                                views[vn]["vscale"], sidx, axis=2)
+                            new["kscales"] = pool[vn]["kscales"].at[
+                                pg, :, off].set(kscol[:, :, 0])
+                            new["vscales"] = pool[vn]["vscales"].at[
+                                pg, :, off].set(vscol[:, :, 0])
+                        pool[vn] = new
 
                     # all-greedy batches skip the PRNG fold-ins and the
                     # full-vocab sort entirely — lax.cond picks the branch
@@ -645,7 +727,7 @@ class GenerationServer:
         net, vocab = self.net, self.vocab
         paged = tuple(self._paged_names)
         pos_only = tuple(self._pos_names)
-        key = ("gen_prefill", self.slots, vocab, bucket)
+        key = ("gen_prefill", self.slots, vocab, bucket, self.kv_dtype)
 
         def build():
             fwd = lm_stream_forward(net)
@@ -660,13 +742,13 @@ class GenerationServer:
                 for vn in pos_only:
                     carry[vn] = {"cache_pos": pos0}
                 for vn in paged:
-                    carry[vn] = {"kpages": pool[vn]["kpages"],
-                                 "vpages": pool[vn]["vpages"],
-                                 "block_table": bt_eff,
-                                 "cache_pos": pos0}
+                    # generic over kv dtypes: int8 pools carry
+                    # kscales/vscales planes alongside kpages/vpages
+                    carry[vn] = dict(pool[vn])
+                    carry[vn]["block_table"] = bt_eff
+                    carry[vn]["cache_pos"] = pos0
                 out, nc = fwd(params, state, onehot, carry, mask)
-                new_pool = {vn: {"kpages": nc[vn]["kpages"],
-                                 "vpages": nc[vn]["vpages"]}
+                new_pool = {vn: {k: nc[vn][k] for k in pool[vn]}
                             for vn in paged}
                 rows = jnp.take_along_axis(
                     out, (sufflen - 1)[:, None, None], axis=1)[:, 0]
@@ -689,13 +771,11 @@ class GenerationServer:
 
         def build():
             def copy(pool, src, dst):
-                out = {}
-                for vn in paged:
-                    kp = pool[vn]["kpages"]
-                    vp = pool[vn]["vpages"]
-                    out[vn] = {"kpages": kp.at[dst].set(kp[src]),
-                               "vpages": vp.at[dst].set(vp[src])}
-                return out
+                # generic per-leaf copy: int8 pools also carry scale
+                # planes, and COW must duplicate them with the values
+                return {vn: {k: a.at[dst].set(a[src])
+                             for k, a in pool[vn].items()}
+                        for vn in paged}
 
             return jax.jit(copy, donate_argnums=(0,))
 
@@ -766,7 +846,8 @@ class GenerationServer:
         # DRAFT's cache (it dies with the draft) keyed by the target's
         # identity — a draft shared across servers never replays a
         # program traced against a different target
-        key = ("gen_spec", id(net), self.slots, vocab, k_spec)
+        key = ("gen_spec", id(net), self.slots, vocab, k_spec,
+               self.kv_dtype)
 
         def build():
             fwd = lm_stream_forward(net)
@@ -817,13 +898,12 @@ class GenerationServer:
                 for vn in pos_only:
                     carry[vn] = {"cache_pos": positions}
                 for vn in paged:
-                    carry[vn] = {"kpages": pool[vn]["kpages"],
-                                 "vpages": pool[vn]["vpages"],
-                                 "block_table": bt,
-                                 "cache_pos": positions}
+                    # generic over kv dtypes (int8 pools add scale planes)
+                    carry[vn] = dict(pool[vn])
+                    carry[vn]["block_table"] = bt
+                    carry[vn]["cache_pos"] = positions
                 out, nc = fwd(params, state, x, carry)   # [S, K, V]
-                new_pool = {vn: {"kpages": nc[vn]["kpages"],
-                                 "vpages": nc[vn]["vpages"]}
+                new_pool = {vn: {k: nc[vn][k] for k in pool[vn]}
                             for vn in paged}
                 true = spec_verify_tokens(out, base_keys, counts, temp,
                                           topk)          # [S, K]
@@ -1633,5 +1713,13 @@ class GenerationServer:
             "spec_proposed": proposed,
             "spec_accepted": accepted,
             "spec_accept_rate": (accepted / proposed) if proposed else 0.0,
+            "kv_cache_dtype": self.kv_dtype or str(
+                np.dtype(self.net.conf.dtype)),
+            "bytes_per_token": self._page_token_bytes,
         }
+        # the admission ledger must agree with the bytes XLA actually
+        # allocated for the pool — satellite guard for the itemsize fix
+        assert self._page_bytes_actual == self._page_bytes, (
+            f"page accounting diverged: predicted {self._page_bytes} "
+            f"bytes/page, allocated {self._page_bytes_actual}")
         return out

@@ -92,7 +92,8 @@ class ParallelInference:
                  breaker: Optional[CircuitBreaker] = None,
                  chaos: Optional[ChaosPolicy] = None,
                  coalescers: int = 1, max_coalescers: int = 4,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 quantize: Optional[str] = None):
         """``max_batch``/``max_wait_ms`` bound the coalescer: a batch is
         dispatched when it reaches ``max_batch`` rows or ``max_wait_ms``
         after its first request, whichever comes first. ``inflight`` bounds
@@ -110,7 +111,16 @@ class ParallelInference:
         with ``CircuitOpen`` while dispatches fail at rate (default
         ``CircuitBreaker()``, pass ``breaker=False`` to disable); ``chaos``
         wraps the dispatch callable with a fault injector — test/bench
-        only, default off."""
+        only, default off.
+
+        ``quantize="int8"`` serves absmax per-channel int8 weights
+        (optimize/quantize.py) with the dequant fused into each matmul;
+        the caller's net is untouched — the server quantizes a shallow
+        copy. Default ``None`` serves the f32 params bit-exact."""
+        if quantize is not None:
+            from deeplearning4j_tpu.optimize.quantize import quantize_net
+            net = quantize_net(net, quantize)
+        self.quantize = quantize
         self.net = net
         self.mesh = mesh if mesh is not None else data_mesh(workers)
         self.workers = self.mesh.devices.size

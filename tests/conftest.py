@@ -163,6 +163,12 @@ def pytest_configure(config):
         "analysis: graftcheck static-analyzer tests (AST rules, baseline "
         "gate, lock-order instrumentation — CPU-fast; the zero-unbaselined"
         "-findings gate runs in tier-1, deliberately NOT in the slow set)")
+    config.addinivalue_line(
+        "markers",
+        "quant: int8 quantization tests (per-channel weight quant "
+        "round-trip and eval parity, int8 paged/streaming KV-cache greedy "
+        "agreement, quantization-off bit-exactness — CPU-fast; runs in "
+        "tier-1, deliberately NOT in the slow set)")
 
 
 @pytest.fixture(autouse=True)
@@ -176,7 +182,8 @@ def _lock_order_debug(request):
             request.node.get_closest_marker("serving")
             or request.node.get_closest_marker("generation")
             or request.node.get_closest_marker("fleet")
-            or request.node.get_closest_marker("metrics")):
+            or request.node.get_closest_marker("metrics")
+            or request.node.get_closest_marker("quant")):
         yield
         return
     from deeplearning4j_tpu.analysis import instrument
