@@ -768,6 +768,169 @@ def bench_serve_fleet(n_requests: int = 96, repeats: int = 3,
     }
 
 
+def bench_serve_handoff(n_requests: int = 64, vocab: int = 17,
+                        steps: int = 48, kill_at_tokens: int = 80):
+    """Crash-durable serving: what does a mid-stream replica death COST?
+    Two legs over the same fleet geometry and the same deterministic kill
+    trigger — token-0 redispatch (``snapshot_every=0``, the pre-handoff
+    behavior) vs crash-durable (``snapshot_every=1``: periodic KV-page
+    snapshots ride each request's future and the fleet adopts the newest
+    one on the survivor). ``n_requests`` mixed greedy+sampled requests of
+    ``steps`` tokens stream through 2 replicas x 2 slots; replica 0 is
+    killed once its live streams are ``kill_at_tokens`` deep, so the
+    token-0 leg must regenerate every one of those tokens while the
+    handoff leg resumes at position N and recomputes only the
+    since-last-snapshot tail.
+
+    Recomputed work is measured from the ledger, not wall clock: the sum
+    of ``tokens_generated`` over every server the factory ever created,
+    minus the tokens the completed requests actually needed. Gates (all
+    raise, never publish): every completion bit-exact vs its serial
+    reference in BOTH legs, the zero-lost-futures ledger in both legs,
+    resumes only in the handoff leg, and handoff recompute <= 10% of the
+    token-0 baseline's."""
+    from deeplearning4j_tpu.models.zoo import (TransformerLM,
+                                               greedy_generate,
+                                               sample_generate)
+    from deeplearning4j_tpu.parallel.fleet import READY, ReplicaFleet
+    from deeplearning4j_tpu.parallel.generation import GenerationServer
+    from deeplearning4j_tpu.parallel.resilience import (ChaosPolicy,
+                                                        ResilienceError)
+
+    net = TransformerLM(num_labels=vocab, max_length=16, d_model=16,
+                        n_heads=2, n_blocks=1, seed=3).init()
+    rng = np.random.default_rng(42)
+    plens = (3, 5, 4)  # mixed lengths over a bounded program set
+    specs = []
+    for i in range(n_requests):
+        p = rng.integers(1, vocab, size=plens[i % 3]).astype(np.int64)
+        specs.append((p, steps, 0.0, 0, 0) if i % 2 == 0
+                     else (p, steps, 0.9, 5, 2000 + i))
+    refs = [greedy_generate(net, p[None], s, vocab)[0]
+            if temp == 0.0 else
+            sample_generate(net, p[None], s, vocab, temperature=temp,
+                            top_k=top_k, seed=seed)[0]
+            for p, s, temp, top_k, seed in specs]
+
+    def submit_retry(fl, spec):
+        p, s, temp, top_k, seed = spec
+        t_end = time.monotonic() + SUB_BENCH_TIMEOUT_S
+        while True:
+            try:
+                return fl.submit(p, s, temperature=temp, top_k=top_k,
+                                 seed=seed,
+                                 deadline_s=SUB_BENCH_TIMEOUT_S)
+            except ResilienceError:
+                if time.monotonic() > t_end:
+                    raise
+                time.sleep(0.01)
+
+    def run_leg(snapshot_every):
+        created = []
+
+        def factory(rid):
+            # the stall keeps streams long enough for the kill trigger
+            # to land mid-generation deterministically
+            chaos = ChaosPolicy(seed=1000 + rid, stall_rate=1.0,
+                                stall_s=0.003)
+            srv = GenerationServer(net, vocab, slots=2, page_size=4,
+                                   snapshot_every=snapshot_every,
+                                   steps_per_dispatch=1, chaos=chaos)
+            created.append(srv)
+            return srv
+
+        fl = ReplicaFleet(factory, replicas=2,
+                          max_pending=2 * n_requests,
+                          replica_max_pending=2 * n_requests,
+                          restart_backoff_s=0.05)
+        try:
+            for sp in specs[:6]:  # warm every program on both replicas
+                submit_retry(fl, sp).result(timeout=SUB_BENCH_TIMEOUT_S)
+            useful_warm = sum(sp[1] for sp in specs[:6])
+            warm0 = (fl.stats()["replicas"][0]["server"]
+                     or {}).get("tokens_generated", 0)
+            t0 = time.perf_counter()
+            futs = [submit_retry(fl, sp) for sp in specs]
+            # kill replica 0 once its live streams are provably deep:
+            # the token-0 leg then pays for every resident token
+            t_kill = time.monotonic() + SUB_BENCH_TIMEOUT_S / 2
+            while True:
+                srv0 = fl.stats()["replicas"][0]["server"] or {}
+                if (srv0.get("active_slots", 0) >= 2
+                        and (srv0.get("tokens_generated", 0) - warm0
+                             >= kill_at_tokens)):
+                    break
+                if time.monotonic() > t_kill:
+                    break
+                time.sleep(0.002)
+            fl.kill_replica(0)
+            outs = [f.result(timeout=SUB_BENCH_TIMEOUT_S) for f in futs]
+            total = time.perf_counter() - t0
+            # let the supervised restart land before reading the ledger
+            t_end = time.monotonic() + 30.0
+            st = fl.stats()
+            while any(r["state"] != READY for r in st["replicas"]):
+                if time.monotonic() > t_end:
+                    break
+                time.sleep(0.02)
+                st = fl.stats()
+        finally:
+            fl.close()
+        bad = sum(1 for o, ref in zip(outs, refs)
+                  if not np.array_equal(np.asarray(o), ref))
+        if bad:
+            raise RuntimeError(
+                f"{bad}/{n_requests} completions differ from their "
+                f"serial references (snapshot_every={snapshot_every})")
+        lost = st["submitted"] - st["completed"] - st["rejected_submits"]
+        if lost or st["inflight"] or st["parked"] or st["failed"] \
+                or st["expired"]:
+            raise RuntimeError(
+                f"fleet leaked {lost} futures (inflight {st['inflight']}"
+                f", parked {st['parked']}, failed {st['failed']}, "
+                f"expired {st['expired']}) across the handoff kill")
+        if st["deaths"] < 1:
+            raise RuntimeError("the kill trigger never fired")
+        gen_total = sum(s.stats()["tokens_generated"] for s in created)
+        useful = n_requests * steps + useful_warm
+        recompute = gen_total - useful
+        ho = {"resumes": 0, "tokens_saved": 0, "bytes": 0}
+        for s in created:
+            h = s.stats()["handoff"]
+            for k in ho:
+                ho[k] += h[k]
+        return (n_requests / total, recompute, st, ho)
+
+    _req_s_0, base_rc, st0, _ho0 = run_leg(0)
+    req_s, handoff_rc, st1, ho1 = run_leg(1)
+    if st0["handoff_resumes"] != 0:
+        raise RuntimeError(
+            "the token-0 baseline leg resumed from a snapshot — the legs "
+            "are not comparable")
+    if st1["handoff_resumes"] < 1 or ho1["resumes"] < 1:
+        raise RuntimeError(
+            "the crash-durable leg never resumed from a snapshot: the "
+            "kill landed outside any snapshotted stream")
+    if base_rc < kill_at_tokens // 2:
+        raise RuntimeError(
+            f"token-0 baseline recomputed only {base_rc} tokens — the "
+            "kill did not land mid-stream; the comparison is void")
+    if handoff_rc > 0.10 * base_rc:
+        raise RuntimeError(
+            f"crash-durable leg recomputed {handoff_rc} tokens vs "
+            f"{base_rc} at token-0 — above the 10% bar snapshots exist "
+            "to clear")
+    return {
+        "serve_handoff_req_s": _sane("serve_handoff_req_s", req_s),
+        "serve_handoff_recompute_tokens": float(handoff_rc),
+        "serve_handoff_token0_recompute_tokens": float(base_rc),
+        "serve_handoff_recompute_frac": handoff_rc / max(1, base_rc),
+        "serve_handoff_resumes": float(st1["handoff_resumes"]),
+        "serve_handoff_tokens_saved": float(ho1["tokens_saved"]),
+        "serve_handoff_snapshot_bytes": float(ho1["bytes"]),
+    }
+
+
 def bench_generate_serve(n_requests: int = 64, slots: int = 64,
                          vocab: int = 256, d_model: int = 256,
                          n_blocks: int = 3, repeats: int = 3):
@@ -1454,6 +1617,7 @@ SANITY_CEILING = {
     "serve_chaos_req_s": 1e8,
     "serve_fleet_req_s": 1e8,
     "serve_fleet_1rep_req_s": 1e8,
+    "serve_handoff_req_s": 1e8,
     "generate_serve_tokens_s": 1e9,
     "generate_serve_serial_tokens_s": 1e9,
     "generate_longtail_tokens_s": 1e9,
@@ -1527,6 +1691,13 @@ METRIC_UNIT = {
     "serve_fleet_deaths": "",
     "serve_fleet_restarts": "",
     "serve_fleet_redispatched": "",
+    "serve_handoff_req_s": "req/s",
+    "serve_handoff_recompute_tokens": "tokens",
+    "serve_handoff_token0_recompute_tokens": "tokens",
+    "serve_handoff_recompute_frac": "",
+    "serve_handoff_resumes": "",
+    "serve_handoff_tokens_saved": "tokens",
+    "serve_handoff_snapshot_bytes": "B",
     "generate_serve_tokens_s": "tokens/s",
     "generate_serve_serial_tokens_s": "tokens/s",
     "generate_serve_speedup": "x",
@@ -1775,7 +1946,7 @@ def main():
     valid = ("all", "resnet50", "vgg16", "lenet", "lstm", "transformer",
              "word2vec", "doc2vec", "attention", "fit_e2e", "eval_e2e",
              "guard_overhead", "metrics_overhead", "inference_serve",
-             "serve_chaos", "serve_fleet", "serve_soak",
+             "serve_chaos", "serve_fleet", "serve_handoff", "serve_soak",
              "generate_serve", "generate_longtail", "quant_serve",
              "quant_infer")
     if which not in valid:
@@ -1834,6 +2005,9 @@ def main():
     if which in ("all", "serve_fleet"):
         _sub_metric(extras, "serve_fleet", bench_serve_fleet)
         headline and headline.sample("post-serve-fleet")
+    if which in ("all", "serve_handoff"):
+        _sub_metric(extras, "serve_handoff", bench_serve_handoff)
+        headline and headline.sample("post-serve-handoff")
     if which in ("all", "serve_soak"):
         _sub_metric(extras, "serve_soak", bench_serve_soak)
         headline and headline.sample("post-serve-soak")

@@ -50,6 +50,8 @@ HOT_FUNCTIONS = {
     "_run_block", "fit_stream",                   # fused-fit driver loop
     "_route_once", "_replica_done",               # fleet router hot path
     "_monitor_loop",                              # fleet redispatch/hedge
+    "_service_parked",                            # fleet resume path
+    "_snapshot_slot", "_adopt_into_slot",         # KV handoff export/adopt
     "_autoscale_tick",                            # autoscaler control loop
     "_soak_arrival_loop",                         # load-generator pacing
     "_snapshot_families",                         # /metrics scrape path

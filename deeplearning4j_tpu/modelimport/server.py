@@ -414,6 +414,21 @@ class KerasBackendServer:
             # fleet-served models carry a "replicas" list here: per-replica
             # health score, breaker state, in-flight depth, restart count
             out["generation"] = {mid: g.stats() for mid, g in gens.items()}
+            # crash-durable serving rollup: sum each generation target's
+            # handoff block (fleet-served targets expose theirs on each
+            # replica's server block instead) so ops reads one number
+            handoff: dict = {}
+            for st in out["generation"].values():
+                blocks = [st["handoff"]] if "handoff" in st else [
+                    rep["server"]["handoff"]
+                    for rep in st.get("replicas", ())
+                    if isinstance(rep.get("server"), dict)
+                    and "handoff" in rep["server"]]
+                for blk in blocks:
+                    for k, v in blk.items():
+                        handoff[k] = handoff.get(k, 0) + v
+            if handoff:
+                out["handoff"] = handoff
         if infs:
             out["inference"] = {mid: i.stats() for mid, i in infs.items()}
         return out

@@ -59,6 +59,7 @@ from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from deeplearning4j_tpu.metrics.registry import MetricsRegistry
+from deeplearning4j_tpu.parallel.handoff import SnapshotError
 from deeplearning4j_tpu.parallel.resilience import (
     AdmissionController, CircuitBreaker, CircuitOpen, Deadline,
     DeadlineExceeded, ReplicaKilled, ReplicaUnavailable, ResilienceError,
@@ -128,7 +129,7 @@ class _FleetRequest:
 
     __slots__ = ("args", "kwargs", "deadline", "future", "resolved",
                  "active", "tried", "attempts", "hedges", "t_dispatch",
-                 "last_error")
+                 "last_error", "snapshot")
 
     def __init__(self, args: tuple, kwargs: dict,
                  deadline: Optional[Deadline], future: Future):
@@ -143,6 +144,10 @@ class _FleetRequest:
         self.hedges = 0
         self.t_dispatch = 0.0
         self.last_error: Optional[BaseException] = None
+        # newest KV snapshot harvested off a failed attempt's future:
+        # the next dispatch ADOPTS it (resume at position N) instead of
+        # regenerating from token 0
+        self.snapshot = None
 
 
 class ReplicaFleet:
@@ -223,6 +228,13 @@ class ReplicaFleet:
             "fleet_deaths_total", "replica deaths observed")
         self._m_restarts = m.counter(
             "fleet_restarts_total", "supervised replica restarts")
+        self._m_handoff_resumes = m.counter(
+            "fleet_handoff_resumes_total",
+            "redispatches resumed from an adopted KV snapshot")
+        self._m_handoff_fallbacks = m.counter(
+            "fleet_handoff_fallbacks_total",
+            "snapshots dropped (invalid/unsupported) for token-0 "
+            "regeneration")
         m.gauge("fleet_replicas", "replica slots in the fleet",
                 fn=lambda: len(self._replicas))
         m.gauge("fleet_parked", "requests parked for re-dispatch",
@@ -336,9 +348,17 @@ class ReplicaFleet:
         return True
 
     def retire_replica(self, rid: int,
-                       timeout: Optional[float] = 30.0) -> bool:
+                       timeout: Optional[float] = 30.0, *,
+                       migrate: bool = False) -> bool:
         """Gracefully drain one replica and take it out of the fleet for
-        good (scale-down). Returns False if it was not READY."""
+        good (scale-down). Returns False if it was not READY.
+
+        ``migrate=True`` moves live requests off the replica instead of
+        waiting them out: the server snapshots each in-flight request
+        and fails it ``RequestMigrated`` with the snapshot attached, and
+        the monitor resumes every one on a surviving replica at its
+        exact stream position (servers without a migrate-capable
+        ``drain`` fall back to the plain wait-out drain)."""
         with self._cond:
             rep = self._replicas[rid]
             if rep.state != READY:
@@ -346,7 +366,13 @@ class ReplicaFleet:
             rep.state = DRAINING
             server = rep.server
         try:
-            server.drain(timeout)
+            if migrate:
+                try:
+                    server.drain(timeout, migrate=True)
+                except TypeError:  # server predates drain(migrate=...)
+                    server.drain(timeout)
+            else:
+                server.drain(timeout)
             server.close(timeout=5.0)
         except Exception:
             pass
@@ -445,6 +471,8 @@ class ReplicaFleet:
             "restarts": int(self._m_restarts.value),
             "parked": parked,
             "inflight": inflight,
+            "handoff_resumes": int(self._m_handoff_resumes.value),
+            "handoff_fallbacks": int(self._m_handoff_fallbacks.value),
         }
         # server/breaker/admission calls take their own locks: keep them
         # outside _cond (replica callbacks already hold server locks when
@@ -533,29 +561,69 @@ class ReplicaFleet:
                 skip.add(rep.rid)
                 continue
             t0 = time.monotonic()
-            try:
-                kwargs = freq.kwargs
-                if freq.deadline is not None:
-                    kwargs = dict(kwargs)
-                    kwargs["deadline_s"] = rem
-                inner = rep.server.submit(*freq.args, **kwargs)
-            except ValueError:
-                rep.admission.release()
-                with self._cond:
-                    rep.inflight -= 1
-                raise
-            except Exception as e:
-                rep.admission.release()
-                with self._cond:
-                    rep.inflight -= 1
-                    rep.rejected += 1
-                    rep.fail_ewma = ((1.0 - self._alpha) * rep.fail_ewma
-                                     + self._alpha)
-                    freq.last_error = e
-                rep.breaker.record_failure()
-                saw_rejection = True
-                skip.add(rep.rid)
-                continue
+            with self._cond:
+                snap = freq.snapshot
+            inner = None
+            if snap is not None and hasattr(rep.server, "adopt_request"):
+                # crash-durable failover: resume from the newest
+                # harvested KV snapshot instead of regenerating from
+                # token 0 — bit-exact either way, the snapshot only
+                # saves the recompute
+                try:
+                    if freq.deadline is not None:
+                        inner = rep.server.adopt_request(
+                            snap, deadline_s=rem)
+                    else:
+                        inner = rep.server.adopt_request(snap)
+                except SnapshotError:
+                    # corrupted/unsupported snapshot is never fatal:
+                    # drop it and fall through to a token-0 submit on
+                    # this same replica
+                    with self._cond:
+                        if freq.snapshot is snap:
+                            freq.snapshot = None
+                    self._m_handoff_fallbacks.inc()
+                except Exception as e:
+                    # adoption refused (overloaded, breaker, closing):
+                    # same handling as a submit rejection — try the
+                    # next replica, snapshot kept for the next attempt
+                    rep.admission.release()
+                    with self._cond:
+                        rep.inflight -= 1
+                        rep.rejected += 1
+                        rep.fail_ewma = ((1.0 - self._alpha)
+                                         * rep.fail_ewma + self._alpha)
+                        freq.last_error = e
+                    rep.breaker.record_failure()
+                    saw_rejection = True
+                    skip.add(rep.rid)
+                    continue
+                else:
+                    self._m_handoff_resumes.inc()
+            if inner is None:
+                try:
+                    kwargs = freq.kwargs
+                    if freq.deadline is not None:
+                        kwargs = dict(kwargs)
+                        kwargs["deadline_s"] = rem
+                    inner = rep.server.submit(*freq.args, **kwargs)
+                except ValueError:
+                    rep.admission.release()
+                    with self._cond:
+                        rep.inflight -= 1
+                    raise
+                except Exception as e:
+                    rep.admission.release()
+                    with self._cond:
+                        rep.inflight -= 1
+                        rep.rejected += 1
+                        rep.fail_ewma = ((1.0 - self._alpha)
+                                         * rep.fail_ewma + self._alpha)
+                        freq.last_error = e
+                    rep.breaker.record_failure()
+                    saw_rejection = True
+                    skip.add(rep.rid)
+                    continue
             with self._cond:
                 freq.tried.add(rep.rid)
                 freq.attempts += 1
@@ -601,6 +669,16 @@ class ReplicaFleet:
             if counted_death:
                 rep.state = DEAD
                 rep.restart_at = time.monotonic() + rep.backoff_s
+            # harvest the attempt's KV snapshot off the failed future
+            # (periodic snapshotting / drain-migrate attach it there);
+            # newest stream position wins across attempts, so failover
+            # resumes from the furthest crash-durable point
+            if exc is not None and not cancelled:
+                snap = getattr(fut, "_kv_snapshot", None)
+                if snap is not None and (
+                        freq.snapshot is None
+                        or snap.count > freq.snapshot.count):
+                    freq.snapshot = snap
             freq.active.pop(rep.rid, None)
             has_twin = len(freq.active) > 0
             is_resolved = freq.resolved

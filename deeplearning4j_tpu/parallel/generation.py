@@ -60,6 +60,14 @@ import numpy as np
 
 from deeplearning4j_tpu.metrics.registry import MetricsRegistry
 from deeplearning4j_tpu.optimize.bucketing import bucket_length, bucket_pages
+from deeplearning4j_tpu.parallel.handoff import (WIRE_VERSION, KVSnapshot,
+                                                 RequestMigrated,
+                                                 SnapshotInvalid,
+                                                 SnapshotUnavailable,
+                                                 SnapshotUnsupported,
+                                                 corrupt_snapshot,
+                                                 pack_snapshot,
+                                                 padded_payload)
 from deeplearning4j_tpu.parallel.resilience import (AdmissionController,
                                                     ChaosPolicy,
                                                     CircuitBreaker,
@@ -77,7 +85,8 @@ GARBAGE_PAGE = 0
 
 class _Request:
     __slots__ = ("prompt", "max_tokens", "temperature", "top_k", "seed",
-                 "eos_id", "deadline", "future", "tokens", "t_submit")
+                 "eos_id", "deadline", "future", "tokens", "t_submit",
+                 "snapshot")
 
     def __init__(self, prompt, max_tokens, temperature, top_k, seed,
                  eos_id, deadline):
@@ -91,6 +100,9 @@ class _Request:
         self.future = Future()
         self.tokens: list = []
         self.t_submit = time.monotonic()
+        # a KVSnapshot to resume from instead of prefilling from token 0
+        # (set by adopt_request and by a preemption that saved its state)
+        self.snapshot = None
 
 
 class _PagePool:
@@ -219,6 +231,7 @@ class GenerationServer:
                  kv_dtype: Optional[str] = None,
                  draft_net=None,
                  spec_k: int = 4,
+                 snapshot_every: int = 0,
                  retry: Optional[RetryPolicy] = None,
                  breaker: Optional[CircuitBreaker] = None,
                  chaos: Optional[ChaosPolicy] = None,
@@ -248,6 +261,17 @@ class GenerationServer:
         self.kv_dtype = kv_dtype
         self._kv_quant = kv_dtype == "int8"
         self.spec_k = int(spec_k)
+        # crash-durable serving: every `snapshot_every` generated tokens
+        # a long-running slot's KV state is exported to a KVSnapshot and
+        # attached to its future (0 = off). The draft's dense cache is
+        # not part of the wire format, so speculative servers cannot
+        # snapshot.
+        self.snapshot_every = max(0, int(snapshot_every))
+        if self.snapshot_every and draft_net is not None:
+            raise ValueError(
+                "snapshot_every is incompatible with draft_net: the "
+                "speculative draft's dense KV cache is not part of the "
+                "KVSnapshot wire format")
         self.admission = AdmissionController(max_pending)
         self.retry = retry if retry is not None else RetryPolicy()
         self.breaker = breaker if breaker is not None else CircuitBreaker()
@@ -308,6 +332,13 @@ class GenerationServer:
         self._slot_seq = [0] * self.slots
         self._admit_seq = 0
         self._page_pool = _PagePool(self.pages_total)
+        # handoff state: per-slot token count at the last snapshot, the
+        # export handshake queue ((request future, out future) pairs the
+        # loop services between dispatches), and the drain-migrate flag
+        self._snap_counts = [0] * self.slots
+        self._export_q: deque = deque()
+        self._migrating = False
+        self._migrate_cb = None
 
         # serving counters live in the (leaf-locked) registry, so the
         # loop thread publishes without ever touching ``_cond`` and a
@@ -357,6 +388,27 @@ class GenerationServer:
             "generation_spec_proposed_total", "draft tokens proposed")
         self._m_spec_accepted = m.counter(
             "generation_spec_accepted_total", "draft tokens accepted")
+        self._m_handoff_snapshots = m.counter(
+            "generation_handoff_snapshots_total",
+            "KV snapshots exported (periodic, explicit, and migrate)")
+        self._m_handoff_bytes = m.counter(
+            "generation_handoff_bytes_total",
+            "wire bytes of exported KV snapshots")
+        self._m_handoff_resumes = m.counter(
+            "generation_handoff_resumes_total",
+            "requests resumed from an adopted KV snapshot")
+        self._m_handoff_saved = m.counter(
+            "generation_handoff_tokens_saved_total",
+            "decoded tokens NOT regenerated thanks to snapshot resume")
+        self._m_handoff_fallbacks = m.counter(
+            "generation_handoff_fallbacks_total",
+            "adoptions that fell back to token-0 prefill")
+        self._m_preempt_resumes = m.counter(
+            "generation_handoff_preempt_resumes_total",
+            "preemptions that saved a snapshot instead of recomputing")
+        self._m_migrated = m.counter(
+            "generation_handoff_migrated_total",
+            "requests migrated off this server by drain(migrate=...)")
         m.gauge("generation_slots", "decode slot pool size",
                 fn=lambda: self.slots)
         m.gauge("generation_active_slots", "slots currently decoding",
@@ -781,6 +833,47 @@ class GenerationServer:
 
         return self.net._get_output(key, build)
 
+    def _page_fetch_program(self):
+        """Snapshot export: gather a block-table-width stack of pool
+        pages (all layers, scale planes included) in one dispatch. NOT
+        donating — the pool stays live; page ids are traced data, so
+        every export replays this one program."""
+        import jax
+
+        paged = tuple(self._paged_names)
+        key = ("gen_page_fetch",)
+
+        def build():
+            def fetch(pool, idx):
+                return {vn: {k: a[idx] for k, a in pool[vn].items()}
+                        for vn in paged}
+
+            return jax.jit(fetch)
+
+        return self.net._get_output(key, build)
+
+    def _page_store_program(self):
+        """Snapshot adopt: scatter a block-table-width stack of page
+        payloads into pool rows ``dst`` (all layers, scale planes
+        included). Rows the adopter does not need (padding, or pages
+        deduped against the prefix cache) are routed to the garbage
+        page. Donating in-place, rebound by the caller — compiled
+        once."""
+        import jax
+
+        paged = tuple(self._paged_names)
+        key = ("gen_page_store",)
+
+        def build():
+            def store(pool, dst, data):
+                return {vn: {k: a.at[dst].set(data[vn][k])
+                             for k, a in pool[vn].items()}
+                        for vn in paged}
+
+            return jax.jit(store, donate_argnums=(0,))
+
+        return self.net._get_output(key, build)
+
     def _draft_prefill_program(self, bucket: int):
         """Draft-side prefill for one pow2 token bucket: consume the full
         (padded, masked) prompt with a fresh batch-1 dense carry and
@@ -987,10 +1080,14 @@ class GenerationServer:
             with self._cond:
                 if self._stop:
                     return
-                if not self._queue and self._n_active == 0:
+                migrating = self._migrating
+                if (not self._queue and self._n_active == 0
+                        and not self._export_q and not migrating):
                     self._cond.wait(timeout=0.5)
                     continue
             try:
+                if migrating:
+                    self._migrate_out()
                 self._admit_free_slots()
                 with self._cond:
                     n_active = self._n_active
@@ -1002,6 +1099,11 @@ class GenerationServer:
                         self._decode_once()
                     self._m_busy_s.inc(time.monotonic() - t0)
                 self._expire_active()
+                # handoff housekeeping rides BETWEEN dispatches: explicit
+                # exports first (a caller is blocked on them), then at
+                # most one periodic low-priority snapshot per iteration
+                self._service_exports()
+                self._maybe_snapshot_slots()
             except Exception as e:  # noqa: BLE001 — a loop death would
                 # hang every outstanding future; fail them typed instead
                 self._fail_all(e)
@@ -1041,6 +1143,10 @@ class GenerationServer:
             if req is None:
                 break
             t0 = time.monotonic()
+            if req.snapshot is not None and self._adopt_into_slot(
+                    s, req, t0):
+                continue
+            # no snapshot (or adoption fell back): token-0 prefill
             plen = req.prompt.shape[0]
             try:
                 pos0 = self._stage_prompt_pages(s, req.prompt, plen)
@@ -1089,14 +1195,28 @@ class GenerationServer:
 
     def _preempt(self, slot: int):
         """Free the most recently admitted slot's pages under pool
-        pressure: its request is requeued at the FRONT with generated
-        tokens discarded — the deterministic key schedule regenerates
-        the identical completion on re-admission, so preemption is
-        invisible in outputs."""
+        pressure: its request is requeued at the FRONT. A victim with at
+        least a page's worth of decoded state snapshots BEFORE its pages
+        are freed, so re-admission ADOPTS the snapshot and resumes at
+        position N instead of recomputing the prefix (the deterministic
+        key schedule makes either path bit-identical, so preemption is
+        invisible in outputs — the snapshot only saves the recompute)."""
         req = self._slot_req[slot]
+        if (req.snapshot is None and self._draft is None
+                and len(req.tokens) >= self._ps):
+            try:
+                snap = self._snapshot_slot(slot)
+            except Exception:  # noqa: BLE001 — best-effort: a failed
+                # snapshot degrades to the legacy recompute, never fails
+                # the request
+                snap = None
+            if snap is not None:
+                req.snapshot = snap
+                self._m_preempt_resumes.inc()
+        if req.snapshot is None:
+            req.tokens.clear()
         self._release_slot_pages(slot)
         self._m_preempted.inc()
-        req.tokens.clear()
         with self._cond:
             self._slot_req[slot] = None
             self._n_active -= 1
@@ -1373,6 +1493,7 @@ class GenerationServer:
         self._register_prefix(slot, req.prompt, plen)
         self._last[slot] = tok
         self._counts[slot] = 1
+        self._snap_counts[slot] = 0  # fresh stream: restart the cadence
         self._temp[slot] = req.temperature
         self._topk[slot] = req.top_k
         self._keys[slot] = key
@@ -1612,10 +1733,339 @@ class GenerationServer:
     def _count_retry(self, attempt, exc):
         self._m_retried.inc()
 
+    # ------------------------------------------------- snapshot/handoff
+    def _snapshot_slot(self, slot: int) -> KVSnapshot:
+        """Serialize slot ``slot``'s live state into a KVSnapshot: the
+        pages holding KV positions [0, pos) — look-ahead pages beyond
+        the stream position hold garbage and are skipped — fetched in
+        ONE non-donating dispatch + ONE device_get, the prefix-cache
+        digests of still-pristine chunk pages, and the resume header
+        from the host mirrors. Loop-thread only; all host-side scalar
+        conversion happens in ``pack_snapshot`` (this function is on the
+        graftcheck hot list)."""
+        import jax
+
+        req = self._slot_req[slot]
+        pos = self._pos[slot]
+        n = -(-pos // self._ps)            # pages holding [0, pos)
+        sp = self._slot_pages[slot]
+        pool = self._page_pool
+        digests = [pool.tag.get(p) for p in sp[:n]]
+        idx = np.zeros(self._np, np.int32)  # pad rows fetch page 0
+        idx[:n] = sp[:n]
+        prog = self._page_fetch_program()
+        fetched = jax.device_get(prog(self._pool, idx))
+        return pack_snapshot(
+            req=req, pos=pos, count=self._counts[slot],
+            last=self._last[slot], key=self._keys[slot].copy(),
+            kv_dtype=self.kv_dtype, page_size=self._ps,
+            page_token_bytes=self._page_token_bytes,
+            page_digests=digests, fetched=fetched, n_pages=n)
+
+    def _publish_snapshot(self, req: _Request, snap: KVSnapshot):
+        """Count the export, run the chaos injector, and attach the
+        snapshot to the request's future — the transport: whoever holds
+        the future (the fleet's done-callback, a migration driver) reads
+        ``future._kv_snapshot`` when the request fails mid-stream."""
+        if self._chaos is not None and self._chaos.handoff_fault():
+            corrupt_snapshot(snap)
+        self._m_handoff_snapshots.inc()
+        self._m_handoff_bytes.inc(snap.wire_bytes())
+        req.future._kv_snapshot = snap
+
+    def _maybe_snapshot_slots(self):
+        """Periodic low-priority snapshotting: at most ONE slot per loop
+        iteration — the most overdue one — so exports never crowd out
+        decode dispatches. Best-effort by design: a failed export leaves
+        the slot exactly as it was (the fleet then falls back to token-0
+        regeneration, which is always correct)."""
+        if not self.snapshot_every:
+            return
+        best, best_lag = -1, 0
+        for s in range(self.slots):
+            if self._slot_req[s] is None:
+                continue
+            lag = int(self._counts[s]) - self._snap_counts[s]
+            if lag >= self.snapshot_every and lag > best_lag:
+                best, best_lag = s, lag
+        if best < 0:
+            return
+        req = self._slot_req[best]
+        try:
+            snap = self._snapshot_slot(best)
+        except Exception:  # noqa: BLE001 — best-effort
+            return
+        self._snap_counts[best] = int(self._counts[best])
+        self._publish_snapshot(req, snap)
+
+    def _service_exports(self):
+        """Resolve queued ``export_request`` handshakes between
+        dispatches (loop thread — the only thread allowed near the
+        pool). Each resolves to a snapshot or fails typed; a request no
+        longer resident in a slot is ``SnapshotUnavailable``."""
+        while True:
+            with self._cond:
+                if not self._export_q:
+                    return
+                fut_in, out = self._export_q.popleft()
+            slot = -1
+            for s in range(self.slots):
+                r = self._slot_req[s]
+                if r is not None and r.future is fut_in:
+                    slot = s
+                    break
+            if slot < 0:
+                self._fail_export(out, SnapshotUnavailable(
+                    "request is not resident in a decode slot (never "
+                    "admitted, already retired, or failed)"))
+                continue
+            try:
+                snap = self._snapshot_slot(slot)
+            except Exception as e:  # noqa: BLE001 — typed to the caller
+                self._fail_export(out, e)
+                continue
+            self._snap_counts[slot] = int(self._counts[slot])
+            self._publish_snapshot(self._slot_req[slot], snap)
+            try:
+                out.set_result(snap)
+            except Exception:  # caller gave up
+                pass
+
+    @staticmethod
+    def _fail_export(out: Future, exc: BaseException):
+        try:
+            out.set_exception(exc)
+        except Exception:  # caller gave up
+            pass
+
+    def export_request(self, future, timeout: Optional[float] = 30.0
+                       ) -> KVSnapshot:
+        """Snapshot the live request behind ``future`` (as returned by
+        ``submit``). Blocks until the serving loop services the export
+        between dispatches. Raises ``SnapshotUnavailable`` when the
+        request is not resident in a slot, ``SnapshotUnsupported`` on a
+        speculative server."""
+        if self._draft is not None:
+            raise SnapshotUnsupported(
+                "speculative servers cannot export: the draft's dense "
+                "KV cache is not part of the KVSnapshot wire format")
+        out: Future = Future()
+        with self._cond:
+            if self._closing:
+                raise RuntimeError("GenerationServer is closed")
+            self._export_q.append((future, out))
+            self._cond.notify_all()
+        return out.result(timeout=timeout)
+
+    def adopt_request(self, snapshot: KVSnapshot, *,
+                      deadline_s: Optional[float] = None) -> Future:
+        """Rebuild a snapshotted request into this server and resume
+        decoding at position N. Validation is all up front and typed:
+        ``SnapshotInvalid`` (bad checksum/version/shape — the caller
+        falls back to token-0 regeneration), ``SnapshotUnsupported``
+        (kv_dtype/page-geometry mismatch or a speculative server),
+        ``ServerOverloaded`` (cannot fit the page budget / admission
+        watermark), ``CircuitOpen``. The resumed completion is
+        byte-identical to the never-interrupted one: the serial
+        ``fold_in(key, token_index)`` schedule rides in the snapshot."""
+        if self._draft is not None:
+            raise SnapshotUnsupported(
+                "speculative servers cannot adopt: the draft's dense "
+                "KV cache is not part of the KVSnapshot wire format")
+        if snapshot.version != WIRE_VERSION:
+            raise SnapshotInvalid(
+                f"KVSnapshot wire version {snapshot.version} != "
+                f"supported {WIRE_VERSION}")
+        if not snapshot.verify():
+            raise SnapshotInvalid("KVSnapshot checksum mismatch")
+        if (snapshot.kv_dtype != self.kv_dtype
+                or snapshot.page_size != self._ps
+                or snapshot.page_token_bytes != self._page_token_bytes):
+            raise SnapshotUnsupported(
+                f"snapshot geometry (kv_dtype={snapshot.kv_dtype!r}, "
+                f"page_size={snapshot.page_size}, "
+                f"{snapshot.page_token_bytes} B/token) does not match "
+                f"this server (kv_dtype={self.kv_dtype!r}, "
+                f"page_size={self._ps}, {self._page_token_bytes} "
+                f"B/token)")
+        plen = int(snapshot.prompt.shape[0])
+        if (snapshot.count != len(snapshot.tokens)
+                or snapshot.pos != plen + snapshot.count - 1
+                or snapshot.n_pages != -(-snapshot.pos // self._ps)):
+            raise SnapshotInvalid(
+                "inconsistent KVSnapshot header: position/count/page "
+                "stack disagree with the token history")
+        need_tokens = plen + snapshot.max_tokens - 1
+        need_pages = -(-need_tokens // self._ps)
+        if need_tokens > self._cap_tokens \
+                or need_pages > self.pages_total - 1:
+            raise ServerOverloaded(
+                f"infeasible adoption: prompt {plen} + max_tokens "
+                f"{snapshot.max_tokens} needs {need_pages} pages / "
+                f"{need_tokens} tokens against capacity "
+                f"{self.pages_total - 1} pages / {self._cap_tokens} "
+                "tokens")
+        if not self.breaker.allow():
+            raise CircuitOpen("circuit breaker is open: recent decode "
+                              "dispatches failed above threshold")
+        budget = deadline_s if deadline_s is not None \
+            else self.request_deadline_s
+        req = _Request(snapshot.prompt.astype(np.int64),
+                       snapshot.max_tokens, snapshot.temperature,
+                       snapshot.top_k, snapshot.seed, snapshot.eos_id,
+                       None if budget is None else Deadline(budget))
+        req.tokens = list(snapshot.tokens)
+        req.snapshot = snapshot
+        self.admission.acquire()  # raises ServerOverloaded at watermark
+        req.future.add_done_callback(lambda _f: self.admission.release())
+        with self._cond:
+            if self._closing:
+                self._fail(req, RuntimeError("GenerationServer is closed"))
+                return req.future
+            self._queue.append(req)
+            self._cond.notify_all()
+        return req.future
+
+    def _adopt_into_slot(self, slot: int, req: _Request, t0: float) -> bool:
+        """Rebuild ``req.snapshot`` into slot ``slot``: pages whose
+        chunk digest is already resident are SHARED out of the prefix
+        cache (no upload — shared prefixes re-dedupe on arrival), the
+        rest are uploaded in ONE donated store dispatch, pristine prompt
+        chunk pages are re-registered for future sharers, and the decode
+        mirrors resume at position N. Returns False after rolling back
+        (pool pressure) — the caller falls back to a token-0 prefill,
+        which is always correct. Loop-thread only; on the graftcheck hot
+        list, so scalar host syncs stay out of here."""
+        snap = req.snapshot
+        pool = self._page_pool
+        sp = self._slot_pages[slot]
+        n = snap.n_pages
+        shared = set()
+        try:
+            for i in range(n):
+                d = snap.page_digests[i]
+                page = pool.lookup(d) \
+                    if (d is not None and self.prefix_cache) else None
+                if page is not None:
+                    pool.share(page)
+                    shared.add(i)
+                else:
+                    page = self._alloc_page(slot)
+                self._bt[slot, i] = page
+                sp.append(page)
+        except RuntimeError:
+            # pool exhausted mid-adoption: roll back and fall back to
+            # the token-0 prefill path (fewer pages via prefix match,
+            # and admission already proved the request itself feasible)
+            self._release_slot_pages(slot)
+            req.snapshot = None
+            req.tokens.clear()
+            self._m_handoff_fallbacks.inc()
+            return False
+        dst = np.zeros(self._np, np.int32)  # pad/dedup rows -> garbage
+        for i in range(n):
+            if i not in shared:
+                dst[i] = self._bt[slot, i]
+        prog = self._page_store_program()
+        self._pool = prog(self._pool, dst, padded_payload(snap, self._np))
+        # re-hash the pristine prompt chunk pages into this server's
+        # prefix cache (the tail page already holds decoded tokens and
+        # must NOT be registered under the whole-prompt tail key)
+        plen = req.prompt.shape[0]
+        if self.prefix_cache:
+            digest = b""
+            ps = self._ps
+            for i in range(min(plen // ps, n)):
+                digest = self._prefix_digest(
+                    digest, req.prompt[i * ps:(i + 1) * ps])
+                pool.register(digest, sp[i])
+        self._last[slot] = snap.last
+        self._counts[slot] = snap.count
+        self._temp[slot] = req.temperature
+        self._topk[slot] = req.top_k
+        self._keys[slot] = snap.key
+        self._pos[slot] = snap.pos
+        self._admit_seq += 1
+        self._slot_seq[slot] = self._admit_seq
+        self._snap_counts[slot] = snap.count
+        req.snapshot = None
+        with self._cond:
+            self._slot_req[slot] = req
+            self._n_active += 1
+        self._m_busy_s.inc(time.monotonic() - t0)
+        self._m_admitted.inc()
+        self._m_handoff_resumes.inc()
+        self._m_handoff_saved.inc(len(req.tokens))
+        if req.tokens and self._finished(req, req.tokens[-1]):
+            self._retire(slot, req)
+        return True
+
+    def _migrate_out(self):
+        """Drain-migrate sweep (loop thread): every live slot is
+        snapshotted at its exact stream position and failed typed with
+        ``RequestMigrated`` — the snapshot rides on the failed future,
+        so a fleet (or any migration driver) adopts it elsewhere and
+        loses zero tokens. Queued requests migrate with whatever
+        snapshot they already carry (usually none: token-0 redispatch).
+        A speculative server migrates snapshot-free — still zero lost
+        futures, just recomputed."""
+        with self._cond:
+            cb = self._migrate_cb
+            self._migrating = False
+            self._migrate_cb = None
+            queued = list(self._queue)
+            self._queue.clear()
+            self._cond.notify_all()
+        for req in queued:
+            if req.snapshot is not None:
+                req.future._kv_snapshot = req.snapshot
+            self._m_migrated.inc()
+            self._fail(req, RequestMigrated(
+                "request migrated off a draining server before prefill"))
+        for s in range(self.slots):
+            req = self._slot_req[s]
+            if req is None:
+                continue
+            snap = None
+            if self._draft is None:
+                try:
+                    snap = self._snapshot_slot(s)
+                except Exception:  # noqa: BLE001 — degrade to token-0
+                    snap = None
+            if snap is not None:
+                self._publish_snapshot(req, snap)
+                if cb is not None:
+                    try:
+                        cb(snap)
+                    except Exception:  # sink errors never lose requests
+                        pass
+            self._release_slot_pages(s)
+            with self._cond:
+                self._slot_req[s] = None
+                self._n_active -= 1
+                self._cond.notify_all()
+            self._m_migrated.inc()
+            self._fail(req, RequestMigrated(
+                "request migrated off a draining server after "
+                f"{len(req.tokens)} tokens"))
+
     # --------------------------------------------------------- lifecycle
-    def drain(self, timeout: Optional[float] = None) -> bool:
+    def drain(self, timeout: Optional[float] = None, *,
+              migrate=False) -> bool:
         """Block until every queued and in-flight request has resolved
-        (completed, expired, or failed). Returns False on timeout."""
+        (completed, expired, or failed). Returns False on timeout.
+
+        ``migrate`` truthy flips the drain from wait-out to move-out:
+        live requests are snapshotted and failed ``RequestMigrated``
+        (snapshot attached to the failed future) instead of being
+        decoded to completion — a fleet resumes them on another replica
+        with zero recompute. Pass a callable to also receive each
+        ``KVSnapshot`` as it is exported."""
+        if migrate:
+            with self._cond:
+                self._migrating = True
+                self._migrate_cb = migrate if callable(migrate) else None
+                self._cond.notify_all()
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cond:
             while self._queue or self._n_active:
@@ -1650,6 +2100,11 @@ class GenerationServer:
             self._queue.clear()
             self._slot_req = [None] * self.slots
             self._n_active = 0
+            exports = list(self._export_q)
+            self._export_q.clear()
+        for _fut, out in exports:  # never leave an exporter hung
+            self._fail_export(out, SnapshotUnavailable(
+                "GenerationServer closed before the export was serviced"))
         for s in stragglers:   # loop thread is joined: safe to touch
             self._release_slot_pages(s)
         for req in victims:
@@ -1716,6 +2171,16 @@ class GenerationServer:
             "kv_cache_dtype": self.kv_dtype or str(
                 np.dtype(self.net.conf.dtype)),
             "bytes_per_token": self._page_token_bytes,
+        }
+        out["handoff"] = {
+            "snapshot_every": self.snapshot_every,
+            "snapshots": int(self._m_handoff_snapshots.value),
+            "bytes": int(self._m_handoff_bytes.value),
+            "resumes": int(self._m_handoff_resumes.value),
+            "tokens_saved": int(self._m_handoff_saved.value),
+            "fallbacks": int(self._m_handoff_fallbacks.value),
+            "preempt_resumes": int(self._m_preempt_resumes.value),
+            "migrated": int(self._m_migrated.value),
         }
         # the admission ledger must agree with the bytes XLA actually
         # allocated for the pool — satellite guard for the itemsize fix

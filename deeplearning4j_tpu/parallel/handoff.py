@@ -1,0 +1,301 @@
+"""KV-page snapshot/restore: crash-durable generation state handoff.
+
+The paged decode path (parallel/generation.py) keeps every bit of a
+live request's restartable state in host mirrors plus device KV pages:
+the prompt, the accepted-token history, the stream position, and the
+sampling params. Because the sampling key schedule is server-state-free
+(``fold_in(PRNGKey(seed), token_index)``), that state is sufficient to
+resume the request anywhere and reproduce the remaining completion
+bit-for-bit. This module gives that state a wire format:
+
+- ``KVSnapshot`` — a versioned, checksummed serialization of one live
+  slot: resident KV pages (stacked per attention layer, int8 pages ship
+  with their scale planes and are ~3.55x smaller than f32), the logical
+  page list with the prefix-cache chunk digests attached, and the resume
+  header (prompt, emitted tokens, position, fold-in count, sampling
+  params). ``to_bytes()``/``from_bytes()`` round-trip it through a flat
+  byte string; ``verify()`` recomputes the sha256 over the content so a
+  corrupted snapshot is detected *before* any page lands in a pool.
+- Prefix dedup both ways: pages whose content is a registered prefix
+  chunk carry their chained digest, so an adopting server that already
+  holds the chunk shares the resident page instead of uploading the
+  payload copy, and uploaded prompt pages are re-registered into the
+  adopter's prefix cache — shared prefixes re-dedupe on arrival.
+- ``export_request(server, future)`` / ``adopt_request(server, snap)``
+  — module-level verbs over ``GenerationServer.export_request`` /
+  ``GenerationServer.adopt_request``.
+
+Consumers: ``GenerationServer`` (periodic ``snapshot_every``
+snapshotting, preemption resume, ``drain(migrate=...)``) and
+``ReplicaFleet`` (mid-stream failover resumes from the newest valid
+snapshot instead of regenerating from token 0).
+
+Snapshots are model-blind: adopting a snapshot into a server whose net
+holds different weights resumes *consistently but meaninglessly* (the
+KV pages encode the exporter's weights). The fleet use — replicas built
+by one factory over shared weights — satisfies this by construction.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import struct
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.parallel.resilience import ResilienceError
+
+#: KVSnapshot wire-format version. Bump on any layout change; adopters
+#: refuse versions they do not speak (typed ``SnapshotInvalid``).
+WIRE_VERSION = 1
+
+_MAGIC = b"KVSN"
+
+
+class SnapshotError(ResilienceError):
+    """Base of the handoff failure taxonomy. Every snapshot/adopt
+    failure is typed so the fleet can fall back to token-0 regeneration
+    instead of losing the request."""
+
+
+class SnapshotInvalid(SnapshotError):
+    """The snapshot failed checksum or version validation — corrupted
+    in transit or produced by an incompatible writer. Never adopted;
+    the caller regenerates from token 0."""
+
+
+class SnapshotUnsupported(SnapshotError):
+    """The snapshot cannot be hosted by this server (kv_dtype/page
+    geometry mismatch, or a speculative-decoding server on either
+    end — the draft's dense cache is not part of the wire format)."""
+
+
+class SnapshotUnavailable(SnapshotError):
+    """No snapshot could be taken: the request is not (or no longer)
+    resident in a decode slot."""
+
+
+class RequestMigrated(ResilienceError):
+    """The request was exported off a draining server mid-stream. The
+    snapshot rides on the failed future (``_kv_snapshot``); a fleet
+    parks the request and resumes it on another replica. HTTP mapping:
+    503 (when it escapes a bare server with no fleet above it)."""
+
+
+def _leaf_items(payload: Dict[str, Dict[str, np.ndarray]]):
+    """Deterministic (vertex, leaf, array) iteration order — the
+    checksum and the byte layout both depend on it."""
+    for vn in sorted(payload):
+        for leaf in sorted(payload[vn]):
+            yield vn, leaf, payload[vn][leaf]
+
+
+class KVSnapshot:
+    """One live generation request, serialized. Header fields are plain
+    Python scalars; ``payload`` stacks the resident pages per attention
+    vertex as ``{vertex: {leaf: [n_pages, ...] array}}`` (int8 pools
+    carry ``kscales``/``vscales`` planes alongside ``kpages``/
+    ``vpages``); ``page_digests[i]`` is the prefix-cache chunk digest of
+    logical page ``i`` when the exporter had it registered, else None.
+    """
+
+    __slots__ = ("version", "prompt", "tokens", "pos", "count", "last",
+                 "key", "temperature", "top_k", "seed", "eos_id",
+                 "max_tokens", "kv_dtype", "page_size",
+                 "page_token_bytes", "page_digests", "payload", "checksum")
+
+    def __init__(self, *, version, prompt, tokens, pos, count, last, key,
+                 temperature, top_k, seed, eos_id, max_tokens, kv_dtype,
+                 page_size, page_token_bytes, page_digests, payload,
+                 checksum=None):
+        self.version = int(version)
+        self.prompt = np.asarray(prompt, np.int64)
+        self.tokens = [int(t) for t in tokens]
+        self.pos = int(pos)
+        self.count = int(count)
+        self.last = int(last)
+        self.key = np.asarray(key, np.uint32)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.seed = int(seed)
+        self.eos_id = None if eos_id is None else int(eos_id)
+        self.max_tokens = int(max_tokens)
+        self.kv_dtype = kv_dtype
+        self.page_size = int(page_size)
+        self.page_token_bytes = int(page_token_bytes)
+        self.page_digests: List[Optional[bytes]] = list(page_digests)
+        self.payload = payload
+        self.checksum = checksum if checksum is not None \
+            else self.content_digest()
+
+    # ------------------------------------------------------ integrity
+    def _header(self) -> dict:
+        return {
+            "version": self.version,
+            "prompt": self.prompt.tolist(),
+            "tokens": self.tokens,
+            "pos": self.pos,
+            "count": self.count,
+            "last": self.last,
+            "key": self.key.tolist(),
+            "temperature": self.temperature,
+            "top_k": self.top_k,
+            "seed": self.seed,
+            "eos_id": self.eos_id,
+            "max_tokens": self.max_tokens,
+            "kv_dtype": self.kv_dtype,
+            "page_size": self.page_size,
+            "page_token_bytes": self.page_token_bytes,
+            "page_digests": [None if d is None else d.hex()
+                             for d in self.page_digests],
+            "leaves": [[vn, leaf, str(a.dtype), list(a.shape)]
+                       for vn, leaf, a in _leaf_items(self.payload)],
+        }
+
+    def content_digest(self) -> bytes:
+        """sha256 over the canonical header AND every payload byte —
+        a single flipped bit anywhere fails ``verify()``."""
+        h = hashlib.sha256()
+        h.update(_MAGIC)
+        h.update(json.dumps(self._header(), sort_keys=True).encode())
+        for _vn, _leaf, a in _leaf_items(self.payload):
+            h.update(np.ascontiguousarray(a).tobytes())
+        return h.digest()
+
+    def verify(self) -> bool:
+        return self.checksum == self.content_digest()
+
+    @property
+    def n_pages(self) -> int:
+        return len(self.page_digests)
+
+    def wire_bytes(self) -> int:
+        """Size of the serialized snapshot — the ``handoff_bytes``
+        accounting (int8 KV shows up here as the ~3.55x shrink)."""
+        header = json.dumps(self._header(), sort_keys=True).encode()
+        n = len(_MAGIC) + 2 + 4 + len(header) + len(self.checksum)
+        for _vn, _leaf, a in _leaf_items(self.payload):
+            n += a.nbytes
+        return n
+
+    # -------------------------------------------------- serialization
+    def to_bytes(self) -> bytes:
+        header = json.dumps(self._header(), sort_keys=True).encode()
+        parts = [_MAGIC, struct.pack("<HI", self.version, len(header)),
+                 header]
+        for _vn, _leaf, a in _leaf_items(self.payload):
+            parts.append(np.ascontiguousarray(a).tobytes())
+        parts.append(self.checksum)
+        return b"".join(parts)
+
+    @classmethod
+    def from_bytes(cls, blob: bytes) -> "KVSnapshot":
+        if len(blob) < len(_MAGIC) + 6 or not blob.startswith(_MAGIC):
+            raise SnapshotInvalid("not a KVSnapshot byte stream")
+        off = len(_MAGIC)
+        version, hlen = struct.unpack_from("<HI", blob, off)
+        if version != WIRE_VERSION:
+            raise SnapshotInvalid(
+                f"KVSnapshot wire version {version} != supported "
+                f"{WIRE_VERSION}")
+        off += 6
+        try:
+            hdr = json.loads(blob[off:off + hlen].decode())
+        except Exception as e:
+            raise SnapshotInvalid(f"unreadable snapshot header: {e}")
+        off += hlen
+        payload: Dict[str, Dict[str, np.ndarray]] = {}
+        for vn, leaf, dtype, shape in hdr["leaves"]:
+            a = np.frombuffer(
+                blob, dtype=np.dtype(dtype), offset=off,
+                count=int(np.prod(shape, dtype=np.int64))
+            ).reshape(shape).copy()
+            payload.setdefault(vn, {})[leaf] = a
+            off += a.nbytes
+        checksum = blob[off:off + 32]
+        snap = cls(
+            version=version, prompt=hdr["prompt"], tokens=hdr["tokens"],
+            pos=hdr["pos"], count=hdr["count"], last=hdr["last"],
+            key=hdr["key"], temperature=hdr["temperature"],
+            top_k=hdr["top_k"], seed=hdr["seed"], eos_id=hdr["eos_id"],
+            max_tokens=hdr["max_tokens"], kv_dtype=hdr["kv_dtype"],
+            page_size=hdr["page_size"],
+            page_token_bytes=hdr["page_token_bytes"],
+            page_digests=[None if d is None else bytes.fromhex(d)
+                          for d in hdr["page_digests"]],
+            payload=payload, checksum=checksum)
+        if not snap.verify():
+            raise SnapshotInvalid("KVSnapshot checksum mismatch")
+        return snap
+
+
+def pack_snapshot(*, req, pos, count, last, key, kv_dtype, page_size,
+                  page_token_bytes, page_digests, fetched,
+                  n_pages) -> KVSnapshot:
+    """Assemble a ``KVSnapshot`` from the server's host mirrors plus one
+    fetched page stack. ``fetched`` is the block-table-width device
+    fetch ``{vertex: {leaf: [NP, ...]}}``; only the first ``n_pages``
+    rows hold this slot's resident KV. Every host conversion (int casts,
+    list copies, array slices) happens HERE, outside the serving loop's
+    hot-named functions."""
+    n = int(n_pages)
+    payload = {vn: {leaf: np.ascontiguousarray(a[:n])
+                    for leaf, a in leaves.items()}
+               for vn, leaves in fetched.items()}
+    return KVSnapshot(
+        version=WIRE_VERSION, prompt=req.prompt, tokens=list(req.tokens),
+        pos=pos, count=count, last=last, key=key,
+        temperature=req.temperature, top_k=req.top_k, seed=req.seed,
+        eos_id=req.eos_id, max_tokens=req.max_tokens, kv_dtype=kv_dtype,
+        page_size=page_size, page_token_bytes=page_token_bytes,
+        page_digests=list(page_digests)[:n], payload=payload)
+
+
+def padded_payload(snap: KVSnapshot, np_pages: int
+                   ) -> Dict[str, Dict[str, np.ndarray]]:
+    """Zero-pad the snapshot's ``[n, ...]`` page stacks to the adopting
+    server's block-table width ``[NP, ...]`` so the one compiled store
+    program fits every adopt (pad rows are routed to the garbage page)."""
+    out: Dict[str, Dict[str, np.ndarray]] = {}
+    for vn, leaves in snap.payload.items():
+        out[vn] = {}
+        for leaf, a in leaves.items():
+            padded = np.zeros((np_pages,) + a.shape[1:], a.dtype)
+            padded[:a.shape[0]] = a
+            out[vn][leaf] = padded
+    return out
+
+
+def corrupt_snapshot(snap: KVSnapshot) -> KVSnapshot:
+    """Flip one payload bit *after* the checksum was computed — the
+    chaos injector's ``snapshot_corrupt`` mode and the test hook for the
+    checksum-fallback path. Returns the same (now invalid) snapshot."""
+    for vn, leaf, a in _leaf_items(snap.payload):
+        if a.size:
+            # leaves off device transfers / frombuffer are read-only:
+            # mutate a copy and swap it into the payload tree
+            b = np.array(a)
+            flat = b.view(np.uint8).reshape(-1)
+            flat[0] ^= 0xFF
+            snap.payload[vn][leaf] = b
+            return snap
+    # pathological empty payload: break the checksum directly
+    snap.checksum = bytes(32)
+    return snap
+
+
+def export_request(server, future, timeout: Optional[float] = 30.0
+                   ) -> KVSnapshot:
+    """Snapshot the live request behind ``future`` on ``server`` (a
+    ``GenerationServer``). Raises ``SnapshotUnavailable`` when the
+    request is not resident in a slot."""
+    return server.export_request(future, timeout=timeout)
+
+
+def adopt_request(server, snapshot: KVSnapshot, **kwargs):
+    """Adopt ``snapshot`` into a free slot of ``server`` and resume
+    decoding at position N. Returns the Future of the resumed request;
+    its result is byte-identical to the never-interrupted completion."""
+    return server.adopt_request(snapshot, **kwargs)

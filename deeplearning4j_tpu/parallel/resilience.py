@@ -306,7 +306,22 @@ class ChaosPolicy:
 
     The replica-mode randoms are drawn only when one of the replica rates
     is non-zero, so pre-existing seeds reproduce the same latency/error
-    sequences as before."""
+    sequences as before.
+
+    Handoff fault modes (for the KV-snapshot migration drills in
+    ``parallel/handoff.py``; injected via ``handoff_fault()`` from the
+    snapshot path, never from ``wrap()``):
+
+    - ``snapshot_corrupt_rate``: the snapshot about to ship gets one
+      payload bit flipped after its checksum was computed, so the
+      adopter's ``verify()`` fails and the fleet falls back to token-0
+      regeneration.
+    - ``handoff_stall_rate``/``handoff_stall_s``: the snapshot path
+      freezes for ``handoff_stall_s`` — a slow migration wire.
+
+    ``handoff_fault()`` draws from the shared rng only when one of the
+    handoff rates is non-zero, so legacy wrap() sequences are
+    reproduced bit-for-bit even on servers that call it every loop."""
 
     def __init__(self, seed: int = 0, transient_rate: float = 0.0,
                  hard_rate: float = 0.0, latency_s: float = 0.0,
@@ -314,6 +329,9 @@ class ChaosPolicy:
                  kill_rate: float = 0.0,
                  stall_rate: float = 0.0, stall_s: float = 0.0,
                  slow_rate: float = 0.0, slow_factor: float = 1.0,
+                 snapshot_corrupt_rate: float = 0.0,
+                 handoff_stall_rate: float = 0.0,
+                 handoff_stall_s: float = 0.0,
                  sleep: Callable[[float], None] = time.sleep):
         self.transient_rate = float(transient_rate)
         self.hard_rate = float(hard_rate)
@@ -324,6 +342,9 @@ class ChaosPolicy:
         self.stall_s = float(stall_s)
         self.slow_rate = float(slow_rate)
         self.slow_factor = float(slow_factor)
+        self.snapshot_corrupt_rate = float(snapshot_corrupt_rate)
+        self.handoff_stall_rate = float(handoff_stall_rate)
+        self.handoff_stall_s = float(handoff_stall_s)
         self._sleep = sleep
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
@@ -333,6 +354,31 @@ class ChaosPolicy:
         self.injected_kill = 0
         self.injected_stall = 0
         self.injected_slow = 0
+        self.injected_snapshot_corrupt = 0
+        self.injected_handoff_stall = 0
+
+    def handoff_fault(self) -> bool:
+        """One seeded draw per snapshot shipped (and only when a handoff
+        rate is non-zero, so wrap() sequences stay pinned). Performs the
+        ``handoff_stall`` sleep itself, outside the rng lock; returns
+        True iff the snapshot should be corrupted. The two modes are
+        mutually exclusive per draw, stacked corrupt-then-stall like the
+        replica modes."""
+        if not (self.snapshot_corrupt_rate or self.handoff_stall_rate):
+            return False
+        with self._lock:
+            r = self._rng.random()
+            corrupt = r < self.snapshot_corrupt_rate
+            stall = (not corrupt
+                     and r < (self.snapshot_corrupt_rate
+                              + self.handoff_stall_rate))
+            if corrupt:
+                self.injected_snapshot_corrupt += 1
+            if stall:
+                self.injected_handoff_stall += 1
+        if stall:
+            self._sleep(self.handoff_stall_s)
+        return corrupt
 
     def wrap(self, fn: Callable) -> Callable:
         """The chaotic twin of ``fn``: same signature, same result, but

@@ -169,6 +169,12 @@ def pytest_configure(config):
         "round-trip and eval parity, int8 paged/streaming KV-cache greedy "
         "agreement, quantization-off bit-exactness — CPU-fast; runs in "
         "tier-1, deliberately NOT in the slow set)")
+    config.addinivalue_line(
+        "markers",
+        "handoff: KV-snapshot/migration serving tests (snapshot "
+        "round-trip bit-exactness, corrupted-checksum fallback, "
+        "mid-stream failover resume, drain-migrate — CPU-fast; runs in "
+        "tier-1, deliberately NOT in the slow set)")
 
 
 @pytest.fixture(autouse=True)
@@ -183,7 +189,8 @@ def _lock_order_debug(request):
             or request.node.get_closest_marker("generation")
             or request.node.get_closest_marker("fleet")
             or request.node.get_closest_marker("metrics")
-            or request.node.get_closest_marker("quant")):
+            or request.node.get_closest_marker("quant")
+            or request.node.get_closest_marker("handoff")):
         yield
         return
     from deeplearning4j_tpu.analysis import instrument

@@ -1,0 +1,511 @@
+"""Crash-durable generation tests (parallel/handoff.py).
+
+Covers the KV-snapshot/live-migration contract end to end on the CPU
+mesh: snapshot export of a live request (resident KV pages, block-table
+row, stream position, RNG fold-in state, accepted tokens) with a
+versioned checksummed wire format, adoption into a DIFFERENT server
+resuming at position N bit-exactly (greedy and sampled, f32 and int8
+pools), corrupted-checksum detection falling back to token-0 replay,
+fleet failover resuming from the newest harvested snapshot after a
+mid-stream replica kill (zero lost futures), drain-migrate handoff on
+both the plain server and ``retire_replica(migrate=True)``, the
+preempt-resume path, the seeded ChaosPolicy handoff fault modes, and
+the zero-retrace property under repeated adoption.
+"""
+
+import time
+from contextlib import contextmanager
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.zoo import (TransformerLM, greedy_generate,
+                                           sample_generate)
+from deeplearning4j_tpu.parallel.fleet import RETIRED, ReplicaFleet
+from deeplearning4j_tpu.parallel.generation import GenerationServer
+from deeplearning4j_tpu.parallel.handoff import (WIRE_VERSION, KVSnapshot,
+                                                 RequestMigrated,
+                                                 SnapshotInvalid,
+                                                 SnapshotUnavailable,
+                                                 SnapshotUnsupported,
+                                                 adopt_request,
+                                                 corrupt_snapshot,
+                                                 export_request)
+from deeplearning4j_tpu.parallel.resilience import (ChaosPolicy,
+                                                    ResilienceError,
+                                                    ServerOverloaded,
+                                                    TransientDispatchError)
+
+V = 17
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return TransformerLM(num_labels=V, max_length=16, d_model=16,
+                         n_heads=2, n_blocks=1, seed=3).init()
+
+
+@contextmanager
+def serving(*args, **kwargs):
+    srv = GenerationServer(*args, **kwargs)
+    try:
+        yield srv
+    finally:
+        srv.close()
+
+
+@contextmanager
+def fleet_of(factory, replicas=2, **kw):
+    fl = ReplicaFleet(factory, replicas=replicas, **kw)
+    try:
+        yield fl
+    finally:
+        fl.close()
+
+
+def _mixed_specs(n, rng, shapes=((3, 4), (5, 5), (4, 6))):
+    specs = []
+    for i in range(n):
+        plen, steps = shapes[i % len(shapes)]
+        p = rng.integers(1, V, size=plen).astype(np.int64)
+        if i % 2 == 0:
+            specs.append((p, steps, 0.0, 0, 0))
+        else:
+            specs.append((p, steps, 0.9, 5, 2000 + i))
+    return specs
+
+
+def _serial_refs(lm, specs):
+    refs = []
+    for p, steps, temp, top_k, seed in specs:
+        if temp == 0.0:
+            refs.append(greedy_generate(lm, p[None], steps, V)[0])
+        else:
+            refs.append(sample_generate(lm, p[None], steps, V,
+                                        temperature=temp, top_k=top_k,
+                                        seed=seed)[0])
+    return refs
+
+
+def _submit_with_backoff(fleet, spec, deadline_s=240.0, budget_s=60.0):
+    p, steps, temp, top_k, seed = spec
+    t_end = time.monotonic() + budget_s
+    while True:
+        try:
+            return fleet.submit(p, steps, temperature=temp, top_k=top_k,
+                                seed=seed, deadline_s=deadline_s)
+        except ResilienceError:
+            if time.monotonic() > t_end:
+                raise
+            time.sleep(0.02)
+
+
+def _run_to_snapshot(lm, spec, **server_kw):
+    """Run one request to completion on a periodically-snapshotting
+    server; return (completed tokens, last published KVSnapshot)."""
+    p, steps, temp, top_k, seed = spec
+    kw = dict(slots=2, page_size=4, snapshot_every=4,
+              steps_per_dispatch=2)
+    kw.update(server_kw)
+    with serving(lm, V, **kw) as srv:
+        fut = srv.submit(p, steps, temperature=temp, top_k=top_k,
+                         seed=seed)
+        out = np.asarray(fut.result(timeout=120))
+        st = srv.stats()["handoff"]
+    snap = getattr(fut, "_kv_snapshot", None)
+    assert snap is not None, "snapshot_every published no snapshot"
+    assert st["snapshots"] >= 1 and st["bytes"] > 0
+    return out, snap
+
+
+GREEDY = (np.array([1, 2, 3, 4], np.int64), 12, 0.0, 0, 0)
+SAMPLED = (np.array([1, 2, 3, 4], np.int64), 12, 0.9, 5, 77)
+
+
+@pytest.mark.handoff
+class TestSnapshotRoundTrip:
+    def test_greedy_f32_resume_bitexact(self, lm):
+        """A mid-stream snapshot adopted into a DIFFERENT server resumes
+        at position N and finishes byte-identical to the uninterrupted
+        greedy stream — no token is recomputed differently."""
+        p = GREEDY[0]
+        ref = greedy_generate(lm, p[None], 12, V)[0]
+        out, snap = _run_to_snapshot(lm, GREEDY)
+        np.testing.assert_array_equal(out, ref)
+        assert 0 < snap.count < 12          # genuinely mid-stream
+        assert snap.version == WIRE_VERSION
+        assert list(snap.tokens) == list(ref[:snap.count])
+        with serving(lm, V, slots=2, page_size=4) as dst:
+            res = adopt_request(dst, snap).result(timeout=120)
+            st = dst.stats()["handoff"]
+        np.testing.assert_array_equal(np.asarray(res), ref)
+        assert st["resumes"] == 1
+        assert st["tokens_saved"] == snap.count
+        assert st["fallbacks"] == 0
+
+    def test_sampled_f32_resume_bitexact(self, lm):
+        """The fold_in key schedule is server-state-free, so a SAMPLED
+        stream resumes bit-exactly on the adopting server too."""
+        p, steps, temp, top_k, seed = SAMPLED
+        ref = sample_generate(lm, p[None], steps, V, temperature=temp,
+                              top_k=top_k, seed=seed)[0]
+        out, snap = _run_to_snapshot(lm, SAMPLED)
+        np.testing.assert_array_equal(out, ref)
+        with serving(lm, V, slots=2, page_size=4) as dst:
+            res = adopt_request(dst, snap).result(timeout=120)
+        np.testing.assert_array_equal(np.asarray(res), ref)
+
+    def test_int8_resume_bitexact_and_wire_ratio(self, lm):
+        """An int8 pool snapshots its quantized pages + scale planes:
+        adoption reproduces the uninterrupted int8 stream bit-exactly,
+        and the wire image ships >= 2.5x smaller than the f32 one at
+        the same stream position."""
+        out_q, snap_q = _run_to_snapshot(lm, GREEDY, kv_dtype="int8")
+        _out_f, snap_f = _run_to_snapshot(lm, GREEDY)
+        assert snap_q.kv_dtype == "int8"
+        assert snap_f.count == snap_q.count  # same publish schedule
+        assert snap_q.wire_bytes() < snap_f.wire_bytes()
+        # page payload (the part that scales with context) shrinks by
+        # the int8 + per-row-scale factor; the JSON header is constant
+        pf = sum(a.nbytes for _, _, a in _leaves(snap_f))
+        pq = sum(a.nbytes for _, _, a in _leaves(snap_q))
+        ratio = pf / pq
+        assert ratio >= 2.5, f"int8 KV payload only {ratio:.2f}x smaller"
+        with serving(lm, V, slots=2, page_size=4, kv_dtype="int8") as dst:
+            res = adopt_request(dst, snap_q).result(timeout=120)
+        np.testing.assert_array_equal(np.asarray(res), out_q)
+
+    def test_wire_bytes_roundtrip(self, lm):
+        """to_bytes/from_bytes is lossless: every header field and every
+        payload leaf round-trips, and the checksum re-verifies."""
+        _out, snap = _run_to_snapshot(lm, SAMPLED)
+        blob = snap.to_bytes()
+        assert len(blob) == snap.wire_bytes()
+        back = KVSnapshot.from_bytes(blob)
+        assert back.verify()
+        for f in ("version", "pos", "count", "last", "temperature",
+                  "top_k", "seed", "kv_dtype", "page_size",
+                  "page_token_bytes", "page_digests"):
+            assert getattr(back, f) == getattr(snap, f), f
+        assert list(back.tokens) == list(snap.tokens)
+        np.testing.assert_array_equal(back.prompt, snap.prompt)
+        np.testing.assert_array_equal(back.key, snap.key)
+        for (vn, leaf, a), (vn2, leaf2, b) in zip(
+                _leaves(snap), _leaves(back)):
+            assert (vn, leaf) == (vn2, leaf2)
+            np.testing.assert_array_equal(a, b)
+
+    def test_wire_rejects_garbage(self, lm):
+        _out, snap = _run_to_snapshot(lm, GREEDY)
+        blob = snap.to_bytes()
+        with pytest.raises(SnapshotInvalid, match="byte stream"):
+            KVSnapshot.from_bytes(b"XXXX" + blob[4:])
+        # flip one payload byte: the sha256 gate catches it
+        mid = len(blob) // 2
+        bad = blob[:mid] + bytes([blob[mid] ^ 0xFF]) + blob[mid + 1:]
+        with pytest.raises(SnapshotInvalid):
+            KVSnapshot.from_bytes(bad)
+
+
+def _leaves(snap):
+    from deeplearning4j_tpu.parallel.handoff import _leaf_items
+    return list(_leaf_items(snap.payload))
+
+
+@pytest.mark.handoff
+class TestExportAndValidation:
+    def test_export_live_request_midstream(self, lm):
+        """export_request snapshots a request WHILE it streams; the
+        exported state adopts elsewhere and both copies finish
+        identical to the serial reference."""
+        p = GREEDY[0]
+        ref = greedy_generate(lm, p[None], 12, V)[0]
+        chaos = ChaosPolicy(seed=5, stall_rate=1.0, stall_s=0.03)
+        with serving(lm, V, slots=2, page_size=4, steps_per_dispatch=1,
+                     chaos=chaos) as src:
+            fut = src.submit(p, 12)
+            time.sleep(0.05)                 # a few stalled dispatches in
+            snap = export_request(src, fut, timeout=60.0)
+            assert 1 <= snap.count <= 12
+            with serving(lm, V, slots=2, page_size=4) as dst:
+                res = adopt_request(dst, snap).result(timeout=120)
+            out = fut.result(timeout=120)
+        np.testing.assert_array_equal(np.asarray(out), ref)
+        np.testing.assert_array_equal(np.asarray(res), ref)
+
+    def test_export_completed_request_unavailable(self, lm):
+        with serving(lm, V, slots=2, page_size=4) as src:
+            fut = src.submit(GREEDY[0], 4)
+            fut.result(timeout=120)
+            with pytest.raises(SnapshotUnavailable):
+                export_request(src, fut, timeout=30.0)
+
+    def test_speculative_server_unsupported(self, lm):
+        """Draft lookahead pages make a slot's KV non-reconstructible
+        mid-round: export refuses typed, and snapshot_every refuses at
+        construction."""
+        with pytest.raises(ValueError, match="snapshot_every"):
+            GenerationServer(lm, V, slots=2, draft_net=lm, spec_k=3,
+                             snapshot_every=4)
+        with serving(lm, V, slots=2, draft_net=lm, spec_k=3) as src:
+            fut = src.submit(GREEDY[0], 4)
+            with pytest.raises(SnapshotUnsupported):
+                export_request(src, fut)
+            fut.result(timeout=120)
+
+    def test_adopt_rejects_corrupt_version_and_geometry(self, lm):
+        _out, snap = _run_to_snapshot(lm, GREEDY)
+        with serving(lm, V, slots=2, page_size=8) as dst:
+            with pytest.raises(SnapshotUnsupported, match="geometry"):
+                adopt_request(dst, snap)
+        with serving(lm, V, slots=2, page_size=4, kv_dtype="int8") as dst:
+            with pytest.raises(SnapshotUnsupported, match="geometry"):
+                adopt_request(dst, snap)
+        with serving(lm, V, slots=2, page_size=4) as dst:
+            snap.version = WIRE_VERSION + 1
+            with pytest.raises(SnapshotInvalid, match="version"):
+                adopt_request(dst, snap)
+            snap.version = WIRE_VERSION
+            corrupt_snapshot(snap)
+            assert not snap.verify()
+            with pytest.raises(SnapshotInvalid, match="checksum"):
+                adopt_request(dst, snap)
+
+    def test_adopt_infeasible_sheds_typed(self, lm):
+        _out, snap = _run_to_snapshot(lm, GREEDY)
+        with serving(lm, V, slots=1, page_size=4, pages=3) as dst:
+            with pytest.raises(ServerOverloaded):
+                adopt_request(dst, snap)
+
+
+@pytest.mark.handoff
+class TestChaosHandoffModes:
+    def test_handoff_faults_deterministic_and_exclusive(self):
+        """Same seed -> same corrupt/stall sequence; at most one handoff
+        fault per draw; stalls sleep outside the policy lock via the
+        injected sleeper."""
+        def run():
+            sleeps = []
+            ch = ChaosPolicy(seed=7, snapshot_corrupt_rate=0.15,
+                             handoff_stall_rate=0.25, handoff_stall_s=0.5,
+                             sleep=sleeps.append)
+            outcomes = [ch.handoff_fault() for _ in range(200)]
+            return outcomes, sleeps, ch
+
+        o1, s1, c1 = run()
+        o2, s2, c2 = run()
+        assert o1 == o2 and s1 == s2
+        assert c1.injected_snapshot_corrupt == c2.injected_snapshot_corrupt
+        assert c1.injected_handoff_stall == c2.injected_handoff_stall
+        assert c1.injected_snapshot_corrupt == sum(o1) > 0
+        assert c1.injected_handoff_stall == len(s1) > 0
+        assert all(s == 0.5 for s in s1)
+
+    def test_legacy_sequences_pinned(self):
+        """Zero-rate handoff knobs draw NOTHING from the chaos RNG: the
+        replica-fault sequence of a seeded policy is byte-identical with
+        the new parameters present and handoff_fault() interleaved."""
+        def pattern(**kw):
+            ch = ChaosPolicy(seed=11, transient_rate=0.3, hard_rate=0.1,
+                             **kw)
+            fn = ch.wrap(lambda: "ok")
+            seq = []
+            for _ in range(200):
+                if kw:
+                    assert ch.handoff_fault() is False
+                try:
+                    seq.append(fn() is not None)
+                except TransientDispatchError:
+                    seq.append("transient")
+                except RuntimeError:
+                    seq.append("hard")
+            return seq
+
+        assert pattern() == pattern(snapshot_corrupt_rate=0.0,
+                                    handoff_stall_rate=0.0)
+
+
+def _wait_replica_midstream(fl, rid, min_snapshots=4, timeout=90.0):
+    """Poll until replica ``rid`` is visibly mid-stream: >= 2 live slots
+    AND enough published snapshots that the live slots are covered.
+    Event-driven, not sleep-calibrated — compile time on a cold program
+    cache just extends the poll."""
+    t_end = time.monotonic() + timeout
+    while True:
+        rep = fl.stats()["replicas"][rid]
+        srv = rep["server"] or {}
+        ho = srv.get("handoff", {})
+        if (srv.get("active_slots", 0) >= 2
+                and ho.get("snapshots", 0) >= min_snapshots):
+            return
+        assert time.monotonic() < t_end, (
+            f"replica {rid} never reached a snapshotted mid-stream "
+            f"state: {srv.get('active_slots')} active, "
+            f"{ho.get('snapshots')} snapshots")
+        time.sleep(0.005)
+
+
+LONG_SHAPES = ((3, 8), (5, 9), (4, 10))
+
+
+@pytest.mark.handoff
+class TestFleetHandoff:
+    def _factory(self, lm, **chaos_kw):
+        def factory(rid):
+            chaos = ChaosPolicy(seed=1000 + rid, **chaos_kw)
+            return GenerationServer(lm, V, slots=4, page_size=4,
+                                    snapshot_every=1, steps_per_dispatch=1,
+                                    chaos=chaos)
+        return factory
+
+    def test_midstream_kill_resumes_from_snapshot(self, lm):
+        """The headline failover: a replica dies under mid-stream
+        requests; the fleet harvests each future's newest snapshot and
+        the survivor resumes at position N — zero lost futures, every
+        completion bit-exact, recompute saved on the handoff counters."""
+        rng = np.random.default_rng(21)
+        specs = _mixed_specs(24, rng, shapes=LONG_SHAPES)
+        refs = _serial_refs(lm, specs)
+        factory = self._factory(lm, stall_rate=1.0, stall_s=0.008)
+        with fleet_of(factory, replicas=2, max_pending=64,
+                      restart_backoff_s=0.02) as fl:
+            futs = [_submit_with_backoff(fl, sp) for sp in specs]
+            _wait_replica_midstream(fl, 0)    # streams mid-generation...
+            fl.kill_replica(0)                # ...die under them
+            outs = [f.result(timeout=600) for f in futs]
+            st = fl.stats()
+        assert len(outs) == 24
+        for got, ref in zip(outs, refs):
+            np.testing.assert_array_equal(np.asarray(got), ref)
+        assert st["completed"] == 24
+        assert st["failed"] == 0 and st["expired"] == 0
+        assert st["deaths"] >= 1
+        assert st["handoff_resumes"] >= 1, \
+            "kill resumed nothing from snapshots"
+
+    def test_corrupted_snapshots_fall_back_to_token0(self, lm):
+        """snapshot_corrupt chaos poisons every published snapshot: the
+        checksum gate rejects them at adoption, the fleet falls back to
+        token-0 replay — still zero lost futures and bit-exact, with the
+        fallbacks (not resumes) counter telling the story."""
+        rng = np.random.default_rng(22)
+        specs = _mixed_specs(16, rng, shapes=LONG_SHAPES)
+        refs = _serial_refs(lm, specs)
+        factory = self._factory(lm, stall_rate=1.0, stall_s=0.008,
+                                snapshot_corrupt_rate=1.0)
+        with fleet_of(factory, replicas=2, max_pending=64,
+                      restart_backoff_s=0.02) as fl:
+            futs = [_submit_with_backoff(fl, sp) for sp in specs]
+            _wait_replica_midstream(fl, 0)
+            fl.kill_replica(0)
+            outs = [f.result(timeout=600) for f in futs]
+            st = fl.stats()
+        for got, ref in zip(outs, refs):
+            np.testing.assert_array_equal(np.asarray(got), ref)
+        assert st["completed"] == 16
+        assert st["failed"] == 0 and st["expired"] == 0
+        assert st["handoff_resumes"] == 0
+        assert st["handoff_fallbacks"] >= 1, \
+            "corrupted snapshots never hit the fallback path"
+
+    def test_retire_migrate_hands_off_live_streams(self, lm):
+        """retire_replica(migrate=True) drains by HANDING OFF: live
+        slots snapshot at their exact position, requeue through the
+        fleet, and finish on the survivor bit-exactly."""
+        rng = np.random.default_rng(23)
+        specs = [(rng.integers(1, V, size=4).astype(np.int64), 10,
+                  0.0, 0, 0) for _ in range(16)]
+        refs = _serial_refs(lm, specs)
+        factory = self._factory(lm, stall_rate=1.0, stall_s=0.01)
+        with fleet_of(factory, replicas=2, max_pending=64,
+                      restart_backoff_s=0.02) as fl:
+            futs = [_submit_with_backoff(fl, sp) for sp in specs]
+            _wait_replica_midstream(fl, 0, min_snapshots=2)
+            assert fl.retire_replica(0, timeout=60.0, migrate=True)
+            outs = [f.result(timeout=600) for f in futs]
+            st = fl.stats()
+        for got, ref in zip(outs, refs):
+            np.testing.assert_array_equal(np.asarray(got), ref)
+        assert st["completed"] == 16
+        assert st["failed"] == 0 and st["expired"] == 0
+        assert st["replicas"][0]["state"] == RETIRED
+        assert st["handoff_resumes"] >= 1, "migration resumed nothing"
+
+
+@pytest.mark.handoff
+class TestServerMigrateAndPreempt:
+    def test_drain_migrate_fails_typed_with_adoptable_snapshots(self, lm):
+        """Plain-server drain(migrate=...): every live request fails
+        typed with RequestMigrated, its snapshot rides both the sink
+        callback and the future — and adopting it elsewhere completes
+        the stream bit-exactly."""
+        rs = np.random.RandomState(31)
+        prompts = [rs.randint(1, V, 4) for _ in range(3)]
+        refs = [greedy_generate(lm, p[None], 12, V)[0] for p in prompts]
+        chaos = ChaosPolicy(seed=9, stall_rate=1.0, stall_s=0.02)
+        collected = []
+        with serving(lm, V, slots=4, page_size=4, steps_per_dispatch=1,
+                     chaos=chaos) as src:
+            futs = [src.submit(p, 12) for p in prompts]
+            while src.stats()["active_slots"] < 3:
+                time.sleep(0.005)             # wait until all prefilled
+            src.drain(timeout=60.0, migrate=collected.append)
+            st = src.stats()["handoff"]
+        assert st["migrated"] == 3
+        assert len(collected) == 3
+        with serving(lm, V, slots=4, page_size=4) as dst:
+            for fut, ref in zip(futs, refs):
+                with pytest.raises(RequestMigrated):
+                    fut.result(timeout=0)
+                snap = fut._kv_snapshot
+                assert snap.verify() and snap.count >= 1
+                res = adopt_request(dst, snap).result(timeout=120)
+                np.testing.assert_array_equal(np.asarray(res), ref)
+            dst_st = dst.stats()["handoff"]
+        assert dst_st["resumes"] == 3
+        assert dst_st["tokens_saved"] == sum(
+            f._kv_snapshot.count for f in futs)
+
+    def test_preempt_snapshots_instead_of_discarding(self, lm):
+        """Pool-pressure preemption keeps the decoded stream: the victim
+        requeues WITH a snapshot, resumes via the adopt path when pages
+        free up, and both requests still finish bit-exactly."""
+        rs = np.random.RandomState(25)
+        pa = rs.randint(1, V, 12)             # 3 pages of prompt each
+        pb = rs.randint(1, V, 12)
+        ra = greedy_generate(lm, pa[None], 10, V)[0]
+        rb = greedy_generate(lm, pb[None], 10, V)[0]
+        # each needs 6 pages end to end; 9 usable < 12 combined
+        with serving(lm, V, slots=2, page_size=4, pages=10,
+                     prefix_cache=False) as srv:
+            fa = srv.submit(pa, 10)
+            fb = srv.submit(pb, 10)
+            np.testing.assert_array_equal(fa.result(timeout=180), ra)
+            np.testing.assert_array_equal(fb.result(timeout=180), rb)
+            st = srv.stats()
+        assert st["pages"]["preempted"] >= 1
+        assert st["handoff"]["preempt_resumes"] >= 1
+        assert st["handoff"]["resumes"] >= 1
+        assert st["handoff"]["tokens_saved"] >= srv._ps
+        assert st["completed"] == 2 and st["failed"] == 0
+
+    def test_no_recompile_on_adoption_churn(self):
+        """Zero-retrace survives handoff: snapshotting compiles ONE
+        gather program, adoption ONE scatter program — then repeated
+        adoptions of fresh snapshots add ZERO compiled programs."""
+        net = TransformerLM(num_labels=V, max_length=16, d_model=8,
+                            n_heads=2, n_blocks=1, seed=9).init()
+        specs = [GREEDY, SAMPLED,
+                 (np.array([2, 5, 1, 3], np.int64), 12, 0.0, 0, 0)]
+        snaps = []
+        for sp in specs:
+            out, snap = _run_to_snapshot(net, sp)
+            snaps.append((snap, out))
+        with serving(net, V, slots=2, page_size=4) as dst:
+            res0 = adopt_request(dst, snaps[0][0]).result(timeout=120)
+            np.testing.assert_array_equal(np.asarray(res0), snaps[0][1])
+            warmed = len(net._output_cache)
+            for snap, out in snaps[1:]:
+                res = adopt_request(dst, snap).result(timeout=120)
+                np.testing.assert_array_equal(np.asarray(res), out)
+            assert len(net._output_cache) == warmed

@@ -417,7 +417,11 @@ class TestLoadGen:
 GEN_KEYS = ["slots", "active_slots", "queued", "admitted", "expired",
             "retired", "completed", "failed", "retried", "pool_rebuilds",
             "prefills", "decode_steps", "tokens_generated", "tokens_per_s",
-            "accepted", "rejected", "pending", "breaker_state", "pages"]
+            "accepted", "rejected", "pending", "breaker_state", "pages",
+            "handoff"]
+GEN_HANDOFF_KEYS = ["snapshot_every", "snapshots", "bytes", "resumes",
+                    "tokens_saved", "fallbacks", "preempt_resumes",
+                    "migrated"]
 GEN_PAGE_KEYS = ["page_size", "pages_total", "pages_free", "pages_cached",
                  "pages_shared", "pages_refcounted", "resident_kv_bytes",
                  "peak_resident_kv_bytes", "cow_copies", "prefix_hits",
@@ -429,6 +433,7 @@ INF_KEYS = ["retried", "expired", "rejected_circuit", "completed", "failed",
 FLEET_KEYS = ["replica_count", "submitted", "rejected_submits", "completed",
               "failed", "expired", "redispatched", "hedged",
               "losers_cancelled", "deaths", "restarts", "parked", "inflight",
+              "handoff_resumes", "handoff_fallbacks",
               "admission", "replicas"]
 FLEET_REPLICA_KEYS = ["rid", "state", "generation", "health_score",
                       "ewma_latency_ms", "failure_ewma", "inflight",
@@ -457,6 +462,7 @@ class TestLegacyStatsShapes:
             srv.close()
         assert list(st.keys()) == GEN_KEYS
         assert list(st["pages"].keys()) == GEN_PAGE_KEYS
+        assert list(st["handoff"].keys()) == GEN_HANDOFF_KEYS
         assert isinstance(st["completed"], int)
         assert isinstance(st["tokens_per_s"], float)
 
