@@ -931,6 +931,256 @@ def bench_serve_handoff(n_requests: int = 64, vocab: int = 17,
     }
 
 
+def bench_serve_disagg(n_requests: int = 24, vocab: int = 17,
+                       steps_long: int = 48, steps_short: int = 8,
+                       ttft_slo_ms: float = 400.0):
+    """Disaggregated prefill/decode tiers: what does splitting the fleet
+    buy on time-to-first-token when long decodes hog the slots?
+
+    Four passes over the same warm net and the same long+short request
+    mix (two-thirds ``steps_long``-token decodes behind short prompts,
+    one-third ``steps_short``-token replies behind long prompts), every
+    pass gated bit-exact against serial references and zero-lost on the
+    fleet ledger (``submitted == completed + failed + expired +
+    rejected``; all raise, never publish):
+
+    1. **co-located baseline** — 2 unified replicas x 2 slots. A slot is
+       held for prefill + the entire decode, so fresh requests queue
+       behind ``steps_long``-token streams and p99 TTFT blows through
+       the SLO. The pass *asserts* the violation: under the same load
+       the baseline must fail the SLO the disagg pass holds, else the
+       workload is too light and the comparison is void.
+    2. **disaggregated** — the same replica/slot budget, but
+       ``roles=("prefill", "decode")``: the prefill tier frees its slot
+       at export (milliseconds), so p99 TTFT stays under
+       ``ttft_slo_ms`` even while the decode tier's queue is deep.
+       TTFT and inter-token latency are read from the fleet's two
+       SEPARATE registry histograms (``fleet_ttft_ms`` /
+       ``fleet_itl_ms``) — never derived from one another.
+    3. **mid-handoff chaos** — a fresh tiered fleet; the prefill
+       replica is killed once handoffs are staged with prefills still
+       in flight. Every request must complete bit-exact, zero lost
+       futures.
+    4. **decode-tier-dark degraded** — the decode replica is killed
+       under a long restart backoff; every request must complete
+       co-located on the prefill tier (``degraded_submits`` >= 1)."""
+    from deeplearning4j_tpu.models.zoo import (TransformerLM,
+                                               greedy_generate,
+                                               sample_generate)
+    from deeplearning4j_tpu.parallel.fleet import READY, ReplicaFleet
+    from deeplearning4j_tpu.parallel.generation import GenerationServer
+    from deeplearning4j_tpu.parallel.resilience import (ChaosPolicy,
+                                                        ResilienceError)
+
+    net = TransformerLM(num_labels=vocab, max_length=16, d_model=16,
+                        n_heads=2, n_blocks=1, seed=3).init()
+    rng = np.random.default_rng(7)
+    specs = []
+    for i in range(n_requests):
+        if i % 3 == 2:  # short reply behind a long prompt
+            p = rng.integers(1, vocab,
+                             size=(10, 12)[i % 2]).astype(np.int64)
+            specs.append((p, steps_short, 0.0, 0, 0))
+        else:           # long decode behind a short prompt
+            p = rng.integers(1, vocab,
+                             size=(3, 5, 4)[i % 3]).astype(np.int64)
+            specs.append((p, steps_long, 0.0, 0, 0) if i % 2 == 0
+                         else (p, steps_long, 0.9, 5, 3000 + i))
+    refs = [greedy_generate(net, p[None], s, vocab)[0]
+            if temp == 0.0 else
+            sample_generate(net, p[None], s, vocab, temperature=temp,
+                            top_k=top_k, seed=seed)[0]
+            for p, s, temp, top_k, seed in specs]
+
+    def submit_retry(fl, spec):
+        p, s, temp, top_k, seed = spec
+        t_end = time.monotonic() + SUB_BENCH_TIMEOUT_S
+        while True:
+            try:
+                return fl.submit(p, s, temperature=temp, top_k=top_k,
+                                 seed=seed,
+                                 deadline_s=SUB_BENCH_TIMEOUT_S)
+            except ResilienceError:
+                if time.monotonic() > t_end:
+                    raise
+                time.sleep(0.01)
+
+    def check_exact(outs, want, tag):
+        bad = sum(1 for o, ref in zip(outs, want)
+                  if not np.array_equal(np.asarray(o), ref))
+        if bad:
+            raise RuntimeError(
+                f"{tag}: {bad}/{len(outs)} completions differ from "
+                "their serial references")
+
+    def check_ledger(st, tag):
+        lost = st["submitted"] - st["completed"] - st["rejected_submits"]
+        if lost or st["inflight"] or st["parked"] or st["failed"] \
+                or st["expired"]:
+            raise RuntimeError(
+                f"{tag}: fleet leaked {lost} futures (inflight "
+                f"{st['inflight']}, parked {st['parked']}, failed "
+                f"{st['failed']}, expired {st['expired']})")
+
+    def make_fleet(roles, **fleet_kw):
+        def factory(rid):
+            # the stall shapes slot residency: a co-located slot is
+            # held for ~steps stalls, a prefill-tier slot for ~one
+            chaos = ChaosPolicy(seed=1000 + rid, stall_rate=1.0,
+                                stall_s=0.004)
+            kw = dict(slots=2, page_size=4, steps_per_dispatch=1,
+                      chaos=chaos)
+            if roles is not None:
+                kw["role"] = roles[rid]
+            return GenerationServer(net, vocab, **kw)
+
+        fkw = dict(max_pending=2 * n_requests,
+                   replica_max_pending=2 * n_requests,
+                   restart_backoff_s=0.05)
+        fkw.update(fleet_kw)
+        if roles is not None:
+            fkw["roles"] = roles
+        return ReplicaFleet(factory, replicas=2, **fkw)
+
+    def run_latency_leg(roles, tag):
+        fl = make_fleet(roles)
+        try:
+            for sp in specs[:4]:  # absorb compiles outside the window
+                submit_retry(fl, sp).result(timeout=SUB_BENCH_TIMEOUT_S)
+            t0 = time.perf_counter()
+            futs = [submit_retry(fl, sp) for sp in specs]
+            outs = [f.result(timeout=SUB_BENCH_TIMEOUT_S) for f in futs]
+            total = time.perf_counter() - t0
+            st = fl.stats()
+            if int(fl.ttft_hist.count) < n_requests \
+                    or int(fl.itl_hist.count) < n_requests:
+                raise RuntimeError(
+                    f"{tag}: latency histograms under-populated "
+                    f"(ttft {int(fl.ttft_hist.count)}, itl "
+                    f"{int(fl.itl_hist.count)} observations for "
+                    f"{n_requests} requests)")
+            lat = {"ttft_p50": float(fl.ttft_hist.quantile(0.5)),
+                   "ttft_p99": float(fl.ttft_hist.quantile(0.99)),
+                   "itl_p50": float(fl.itl_hist.quantile(0.5)),
+                   "itl_p99": float(fl.itl_hist.quantile(0.99))}
+        finally:
+            fl.close()
+        check_exact(outs, refs, tag)
+        check_ledger(st, tag)
+        return n_requests / total, lat, st
+
+    def run_chaos_leg():
+        fl = make_fleet(("prefill", "decode"))
+        try:
+            futs = [submit_retry(fl, sp) for sp in specs]
+            # kill the prefill replica mid-handoff: snapshots staged
+            # AND prefills still resident, so both the parked and the
+            # inflight recovery paths are exercised in one pass
+            t_kill = time.monotonic() + SUB_BENCH_TIMEOUT_S / 2
+            armed = False
+            while True:
+                st = fl.stats()
+                srv0 = st["replicas"][0]["server"] or {}
+                if (st["tier_handoffs"] >= 2
+                        and srv0.get("active_slots", 0) >= 1):
+                    armed = True
+                    break
+                if time.monotonic() > t_kill:
+                    break
+                time.sleep(0.0005)
+            if not armed:
+                raise RuntimeError(
+                    "chaos pass: never observed staged handoffs with "
+                    "prefills still in flight — the kill would not "
+                    "land mid-handoff")
+            fl.kill_replica(0)
+            outs = [f.result(timeout=SUB_BENCH_TIMEOUT_S) for f in futs]
+            # let the supervised restart land before the ledger read
+            t_end = time.monotonic() + 30.0
+            st = fl.stats()
+            while any(r["state"] != READY for r in st["replicas"]):
+                if time.monotonic() > t_end:
+                    break
+                time.sleep(0.02)
+                st = fl.stats()
+        finally:
+            fl.close()
+        check_exact(outs, refs, "chaos pass")
+        check_ledger(st, "chaos pass")
+        if st["deaths"] < 1:
+            raise RuntimeError("chaos pass: the kill never fired")
+        return st
+
+    def run_degraded_leg():
+        fl = make_fleet(("prefill", "decode"), restart_backoff_s=30.0)
+        sub = specs[:8]
+        try:
+            t_end = time.monotonic() + 30.0
+            while any(r["state"] != READY
+                      for r in fl.stats()["replicas"]):
+                if time.monotonic() > t_end:
+                    raise RuntimeError(
+                        "degraded pass: fleet never became READY")
+                time.sleep(0.01)
+            fl.kill_replica(1)  # decode tier dark for the whole pass
+            futs = [submit_retry(fl, sp) for sp in sub]
+            outs = [f.result(timeout=SUB_BENCH_TIMEOUT_S) for f in futs]
+            st = fl.stats()
+        finally:
+            fl.close()
+        check_exact(outs, refs[:len(sub)], "degraded pass")
+        check_ledger(st, "degraded pass")
+        if st["completed"] < len(sub):
+            raise RuntimeError(
+                f"degraded pass completed only {st['completed']}/"
+                f"{len(sub)} requests with the decode tier dark")
+        if st["degraded_submits"] < 1:
+            raise RuntimeError(
+                "degraded pass: decode tier was dark yet no submit "
+                "was served co-located on the prefill tier")
+        return st
+
+    colo_req_s, colo_lat, _colo_st = run_latency_leg(
+        None, "co-located baseline")
+    dis_req_s, dis_lat, dis_st = run_latency_leg(
+        ("prefill", "decode"), "disagg pass")
+    if dis_st["tier_handoffs"] < n_requests:
+        raise RuntimeError(
+            f"disagg pass staged only {dis_st['tier_handoffs']} "
+            f"handoffs for {n_requests} requests — the tier pipeline "
+            "was bypassed")
+    if dis_lat["ttft_p99"] >= ttft_slo_ms:
+        raise RuntimeError(
+            f"disagg p99 TTFT {dis_lat['ttft_p99']:.1f} ms violates "
+            f"the {ttft_slo_ms:.0f} ms SLO it exists to hold")
+    if colo_lat["ttft_p99"] <= ttft_slo_ms:
+        raise RuntimeError(
+            f"co-located p99 TTFT {colo_lat['ttft_p99']:.1f} ms "
+            f"already meets the {ttft_slo_ms:.0f} ms SLO — load too "
+            "light, the disagg win is unmeasured")
+    chaos_st = run_chaos_leg()
+    deg_st = run_degraded_leg()
+    return {
+        # colo first: the standalone headline picker takes the LAST
+        # sanity-ceiling'd key, and the disagg number is the headline
+        "serve_colo_req_s": _sane("serve_colo_req_s", colo_req_s),
+        "serve_disagg_req_s": _sane("serve_disagg_req_s", dis_req_s),
+        "serve_disagg_ttft_p50_ms": round(dis_lat["ttft_p50"], 2),
+        "serve_disagg_ttft_p99_ms": round(dis_lat["ttft_p99"], 2),
+        "serve_disagg_itl_p50_ms": round(dis_lat["itl_p50"], 2),
+        "serve_disagg_itl_p99_ms": round(dis_lat["itl_p99"], 2),
+        "serve_colo_ttft_p50_ms": round(colo_lat["ttft_p50"], 2),
+        "serve_colo_ttft_p99_ms": round(colo_lat["ttft_p99"], 2),
+        "serve_colo_itl_p50_ms": round(colo_lat["itl_p50"], 2),
+        "serve_disagg_ttft_slo_ms": float(ttft_slo_ms),
+        "serve_disagg_tier_handoffs": float(dis_st["tier_handoffs"]),
+        "serve_disagg_chaos_redispatched":
+            float(chaos_st["redispatched"]),
+        "serve_disagg_degraded_submits":
+            float(deg_st["degraded_submits"]),
+    }
+
+
 def bench_generate_serve(n_requests: int = 64, slots: int = 64,
                          vocab: int = 256, d_model: int = 256,
                          n_blocks: int = 3, repeats: int = 3):
@@ -1618,6 +1868,8 @@ SANITY_CEILING = {
     "serve_fleet_req_s": 1e8,
     "serve_fleet_1rep_req_s": 1e8,
     "serve_handoff_req_s": 1e8,
+    "serve_disagg_req_s": 1e8,
+    "serve_colo_req_s": 1e8,
     "generate_serve_tokens_s": 1e9,
     "generate_serve_serial_tokens_s": 1e9,
     "generate_longtail_tokens_s": 1e9,
@@ -1698,6 +1950,19 @@ METRIC_UNIT = {
     "serve_handoff_resumes": "",
     "serve_handoff_tokens_saved": "tokens",
     "serve_handoff_snapshot_bytes": "B",
+    "serve_disagg_req_s": "req/s",
+    "serve_colo_req_s": "req/s",
+    "serve_disagg_ttft_p50_ms": "ms",
+    "serve_disagg_ttft_p99_ms": "ms",
+    "serve_disagg_itl_p50_ms": "ms",
+    "serve_disagg_itl_p99_ms": "ms",
+    "serve_colo_ttft_p50_ms": "ms",
+    "serve_colo_ttft_p99_ms": "ms",
+    "serve_colo_itl_p50_ms": "ms",
+    "serve_disagg_ttft_slo_ms": "ms",
+    "serve_disagg_tier_handoffs": "",
+    "serve_disagg_chaos_redispatched": "",
+    "serve_disagg_degraded_submits": "",
     "generate_serve_tokens_s": "tokens/s",
     "generate_serve_serial_tokens_s": "tokens/s",
     "generate_serve_speedup": "x",
@@ -1946,7 +2211,8 @@ def main():
     valid = ("all", "resnet50", "vgg16", "lenet", "lstm", "transformer",
              "word2vec", "doc2vec", "attention", "fit_e2e", "eval_e2e",
              "guard_overhead", "metrics_overhead", "inference_serve",
-             "serve_chaos", "serve_fleet", "serve_handoff", "serve_soak",
+             "serve_chaos", "serve_fleet", "serve_handoff", "serve_disagg",
+             "serve_soak",
              "generate_serve", "generate_longtail", "quant_serve",
              "quant_infer")
     if which not in valid:
@@ -2008,6 +2274,9 @@ def main():
     if which in ("all", "serve_handoff"):
         _sub_metric(extras, "serve_handoff", bench_serve_handoff)
         headline and headline.sample("post-serve-handoff")
+    if which in ("all", "serve_disagg"):
+        _sub_metric(extras, "serve_disagg", bench_serve_disagg)
+        headline and headline.sample("post-serve-disagg")
     if which in ("all", "serve_soak"):
         _sub_metric(extras, "serve_soak", bench_serve_soak)
         headline and headline.sample("post-serve-soak")

@@ -52,6 +52,8 @@ HOT_FUNCTIONS = {
     "_monitor_loop",                              # fleet redispatch/hedge
     "_service_parked",                            # fleet resume path
     "_snapshot_slot", "_adopt_into_slot",         # KV handoff export/adopt
+    "_tier_route",                                # disagg tier routing
+    "_transfer_loop",                             # prefill->decode export
     "_autoscale_tick",                            # autoscaler control loop
     "_soak_arrival_loop",                         # load-generator pacing
     "_snapshot_families",                         # /metrics scrape path

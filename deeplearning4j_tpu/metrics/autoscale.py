@@ -31,7 +31,7 @@ import time
 from deeplearning4j_tpu.metrics.registry import MetricsRegistry
 
 __all__ = ["Autoscaler", "ScaleDecision", "GenerationSlotsTarget",
-           "CoalescerTarget"]
+           "CoalescerTarget", "FleetTierTarget"]
 
 
 class ScaleDecision:
@@ -127,6 +127,69 @@ class CoalescerTarget(_StatsTarget):
 
     def set(self, n):
         self._srv.set_coalescer_workers(n)
+
+
+class FleetTierTarget:
+    """Per-tier slot lever over a disaggregated ReplicaFleet: one
+    independent Autoscaler target per ``role`` (prefill capacity bounds
+    TTFT, decode capacity bounds inter-token latency — they must scale
+    separately). Observes aggregate queue depth and deadline-miss rate
+    from ``fleet.tier_stats(role)`` counter deltas and moves the tier's
+    shared active-slot admission cap via
+    ``fleet.set_tier_active_slots(role, n)``."""
+
+    depth_key = "queued"
+
+    def __init__(self, fleet, role, max_slots=None):
+        if role not in ("prefill", "decode", "unified"):
+            raise ValueError(f"unknown tier role {role!r}")
+        self._fleet = fleet
+        self._role = role
+        self.name = f"fleet_{role}_slots"
+        self._max_slots = max_slots
+        self._prev_misses = 0
+        self._prev_served = 0
+        self._level = None  # tracked cap survives tier-dark windows
+
+    @property
+    def min_level(self):
+        return 1
+
+    @property
+    def max_level(self):
+        if self._max_slots is not None:
+            return self._max_slots
+        st = self._fleet.tier_stats(self._role)
+        reps = st["replicas"]
+        if reps == 0:
+            return self._level if self._level is not None else 1
+        # the cap is per replica server, so the lever's ceiling is the
+        # largest per-replica slot pool in the tier
+        return max(1, st["slots"] // reps)
+
+    def observe(self):
+        st = self._fleet.tier_stats(self._role)
+        misses = st["expired"]
+        served = st["completed"]
+        dm = max(0, misses - self._prev_misses)
+        ds = max(0, served - self._prev_served)
+        self._prev_misses = misses
+        self._prev_served = served
+        total = dm + ds
+        rate = dm / total if total > 0 else 0.0
+        return st[self.depth_key], rate
+
+    def get(self):
+        st = self._fleet.tier_stats(self._role)
+        if st["replicas"] == 0:  # tier dark: hold the last known level
+            return self._level if self._level is not None else 1
+        level = max(1, st["active_slots"] // st["replicas"])
+        self._level = level
+        return level
+
+    def set(self, n):
+        self._level = n
+        self._fleet.set_tier_active_slots(self._role, n)
 
 
 class Autoscaler:

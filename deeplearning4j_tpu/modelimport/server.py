@@ -43,7 +43,7 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Optional, Sequence
 
 import numpy as np
 
@@ -274,6 +274,7 @@ class KerasBackendServer:
                           eos_id: Optional[int] = None,
                           mid: Optional[str] = None, replicas: int = 1,
                           fleet_kw: Optional[dict] = None,
+                          roles: Optional[Sequence[str]] = None,
                           **gen_kw) -> str:
         """Register a causal LM for /generate, served by a paged
         ``GenerationServer`` (continuous batching over a page-pool
@@ -293,7 +294,14 @@ class KerasBackendServer:
         restart, zero lost futures across replica death — parallel/
         fleet.py); ``fleet_kw`` forwards to the fleet (hedge_after_s,
         restart_backoff_s, ...). The per-replica health/breaker/restart
-        block then appears under this model in /stats."""
+        block then appears under this model in /stats.
+
+        ``roles`` (rid-indexed, e.g. ``("prefill", "decode")``) serves
+        the model through *disaggregated* tiers: each replica's
+        GenerationServer is built with its declared role and the fleet
+        routes fresh requests through prefill-export -> decode-adopt,
+        degrading to co-located serving when the decode tier is dark.
+        Requires ``replicas == len(roles) > 1``."""
         from deeplearning4j_tpu.parallel.fleet import ReplicaFleet
         from deeplearning4j_tpu.parallel.generation import GenerationServer
 
@@ -308,12 +316,20 @@ class KerasBackendServer:
             old = self._generators.pop(mid, None)
         if old is not None:
             old.close()
+        if roles is not None and int(replicas) <= 1:
+            raise ValueError("roles= needs replicas > 1 (one server "
+                             "per tier replica)")
         if int(replicas) > 1:
             def factory(rid):
+                kw = dict(gen_kw)
+                if roles is not None:
+                    kw["role"] = roles[rid]
                 return GenerationServer(net, vocab, slots=slots,
-                                        eos_id=eos_id, **gen_kw)
-            gen = ReplicaFleet(factory, replicas=int(replicas),
-                               **(fleet_kw or {}))
+                                        eos_id=eos_id, **kw)
+            fkw = dict(fleet_kw or {})
+            if roles is not None:
+                fkw.setdefault("roles", tuple(roles))
+            gen = ReplicaFleet(factory, replicas=int(replicas), **fkw)
         else:
             gen = GenerationServer(net, vocab, slots=slots, eos_id=eos_id,
                                    **gen_kw)

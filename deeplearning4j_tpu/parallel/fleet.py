@@ -47,23 +47,40 @@ longer than ``hedge_after_s``, the monitor launches a duplicate on a
 different healthy replica; the first result wins and the loser is
 cancelled. This bounds straggler-replica tail latency at the cost of
 duplicated work.
+
+Disaggregated tiers (optional, ``roles=``): DistServe/Splitwise-style
+prefill/decode separation behind the same ``submit() -> Future``. A
+fresh request routes to a prefill-capable replica, which runs chunked
+wave prefill and resolves the attempt with a **KVSnapshot** instead of
+tokens; the fleet stages the snapshot and re-routes it onto the decode
+tier, where ``adopt_request`` resumes it at its exact stream position.
+The caller's future only ever resolves with final tokens. TTFT (submit
+-> first prefilled token) and inter-token latency land in separate
+registry histograms (``fleet_ttft_ms`` / ``fleet_itl_ms``). When the
+decode tier has no READY replica the fleet enters **degraded mode** —
+co-located serving on the prefill tier (fresh submits are pinned
+``export_kv=False``, staged snapshots adopt in place) — and recovers
+automatically when a decode-capable replica heals.
 """
 
 from __future__ import annotations
 
 import functools
+import logging
 import threading
 import time
 from collections import deque
 from concurrent.futures import Future
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from deeplearning4j_tpu.metrics.registry import MetricsRegistry
-from deeplearning4j_tpu.parallel.handoff import SnapshotError
+from deeplearning4j_tpu.parallel.handoff import KVSnapshot, SnapshotError
 from deeplearning4j_tpu.parallel.resilience import (
     AdmissionController, CircuitBreaker, CircuitOpen, Deadline,
     DeadlineExceeded, ReplicaKilled, ReplicaUnavailable, ResilienceError,
     ServerOverloaded)
+
+log = logging.getLogger(__name__)
 
 # Replica lifecycle: SPAWNING -> WARMING -> READY -> (DRAINING -> RETIRED
 # | DEAD -> SPAWNING ...). Only READY replicas take traffic; DEAD ones are
@@ -85,18 +102,20 @@ class _Replica:
     at construction and safe to read anywhere)."""
 
     __slots__ = ("rid", "generation", "server", "breaker", "admission",
-                 "state", "inflight", "ewma_ms", "fail_ewma", "restarts",
-                 "spawn_failures", "backoff_s", "restart_at", "dispatched",
-                 "completed", "failed", "rejected", "prior_trips")
+                 "role", "state", "inflight", "ewma_ms", "fail_ewma",
+                 "restarts", "spawn_failures", "backoff_s", "restart_at",
+                 "dispatched", "completed", "failed", "rejected",
+                 "prior_trips")
 
     def __init__(self, rid: int, generation: int, server: Any,
                  breaker: CircuitBreaker, admission: AdmissionController,
-                 backoff_s: float):
+                 backoff_s: float, role: str = "unified"):
         self.rid = rid
         self.generation = generation
         self.server = server
         self.breaker = breaker
         self.admission = admission
+        self.role = role
         self.state = READY
         self.inflight = 0
         self.ewma_ms = 0.0
@@ -129,7 +148,7 @@ class _FleetRequest:
 
     __slots__ = ("args", "kwargs", "deadline", "future", "resolved",
                  "active", "tried", "attempts", "hedges", "t_dispatch",
-                 "last_error", "snapshot")
+                 "last_error", "snapshot", "t_submit", "t_first")
 
     def __init__(self, args: tuple, kwargs: dict,
                  deadline: Optional[Deadline], future: Future):
@@ -137,6 +156,8 @@ class _FleetRequest:
         self.kwargs = kwargs
         self.deadline = deadline
         self.future = future
+        self.t_submit = time.monotonic()
+        self.t_first = 0.0  # when the fleet first saw a token (TTFT)
         self.resolved = False
         self.active: Dict[int, Future] = {}  # rid -> in-flight inner future
         self.tried: set = set()
@@ -161,6 +182,12 @@ class ReplicaFleet:
     disables supervised restart (dead replicas stay dead); ``warmup`` is
     an optional callable run on every freshly spawned server before it
     takes traffic (e.g. a canary request that pre-compiles programs).
+
+    ``roles`` (one of ``"prefill"``/``"decode"``/``"unified"`` per
+    replica, rid-indexed) turns on disaggregated tier routing — see the
+    module docstring. The factory must build a server matching the
+    declared role (``GenerationServer(role=...)``); supervised restart
+    rebuilds the same rid with the same role.
     """
 
     def __init__(self, factory: Callable[[int], Any], replicas: int = 2, *,
@@ -173,9 +200,28 @@ class ReplicaFleet:
                  breaker_factory: Optional[Callable[[], CircuitBreaker]]
                  = None,
                  health_alpha: float = 0.25, tick_s: float = 0.005,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 roles: Optional[Sequence[str]] = None):
         if int(replicas) < 1:
             raise ValueError("need at least one replica")
+        if roles is not None:
+            roles = tuple(roles)
+            if len(roles) != int(replicas):
+                raise ValueError(
+                    f"roles must name one role per replica "
+                    f"({int(replicas)}), got {len(roles)}")
+            bad = sorted({x for x in roles
+                          if x not in ("unified", "prefill", "decode")})
+            if bad:
+                raise ValueError(f"unknown replica roles {bad!r}")
+            if any(x != "unified" for x in roles):
+                if not any(x in ("prefill", "unified") for x in roles):
+                    raise ValueError("a tiered fleet needs at least one "
+                                     "prefill-capable replica")
+                if not any(x in ("decode", "unified") for x in roles):
+                    raise ValueError("a tiered fleet needs at least one "
+                                     "decode-capable replica")
+        self._roles = roles
         self._factory = factory
         self._warmup = warmup
         self._breaker_factory = breaker_factory
@@ -198,6 +244,7 @@ class ReplicaFleet:
         self._replicas: List[_Replica] = []
         self._closing = False
         self._stop = False
+        self._degraded = False  # decode tier dark -> co-located serving
         # fleet-wide aggregates live in the (leaf-locked) registry: the
         # routing path and completion callbacks publish without holding
         # _cond, and a scrape never contends with routing. Per-replica
@@ -235,6 +282,25 @@ class ReplicaFleet:
             "fleet_handoff_fallbacks_total",
             "snapshots dropped (invalid/unsupported) for token-0 "
             "regeneration")
+        self._m_tier_handoffs = m.counter(
+            "fleet_tier_handoffs_total",
+            "prefill->decode KVSnapshot handoffs staged by the tier "
+            "pipeline")
+        self._m_degraded_submits = m.counter(
+            "fleet_degraded_submits_total",
+            "requests served co-located on the prefill tier while the "
+            "decode tier was dark")
+        # TTFT vs inter-token latency are *separate* SLOs in a
+        # disaggregated topology: prefill capacity bounds the first,
+        # decode capacity the second. Keep them in separate histograms.
+        self.ttft_hist = m.histogram(
+            "fleet_ttft_ms", "time from submit to first token (ms)")
+        self.itl_hist = m.histogram(
+            "fleet_itl_ms", "mean inter-token latency per request (ms)")
+        m.gauge("fleet_degraded_mode",
+                "1 while the decode tier has no READY replica and the "
+                "fleet serves co-located on the prefill tier",
+                fn=lambda: 1.0 if self._degraded else 0.0)
         m.gauge("fleet_replicas", "replica slots in the fleet",
                 fn=lambda: len(self._replicas))
         m.gauge("fleet_parked", "requests parked for re-dispatch",
@@ -253,6 +319,7 @@ class ReplicaFleet:
             if warmup is not None:
                 warmup(server)
             self._replicas.append(self._new_replica(rid, 0, server))
+        self._tiered = any(r.role != "unified" for r in self._replicas)
 
         self._monitor = threading.Thread(target=self._monitor_loop,
                                          name="fleet-monitor", daemon=True)
@@ -269,8 +336,17 @@ class ReplicaFleet:
                                      min_calls=6, reset_timeout_s=0.25)
         admission = AdmissionController(
             max_pending=self._replica_max_pending)
+        srole = getattr(server, "role", None)
+        if self._roles is not None:
+            role = self._roles[rid]
+            if srole is not None and srole != role:
+                raise ValueError(
+                    f"replica {rid}: fleet roles[{rid}]={role!r} but the "
+                    f"factory built a {srole!r} server")
+        else:
+            role = srole if srole is not None else "unified"
         return _Replica(rid, generation, server, breaker, admission,
-                        self._restart_backoff_s)
+                        self._restart_backoff_s, role=role)
 
     # -- public surface ------------------------------------------------
 
@@ -438,11 +514,13 @@ class ReplicaFleet:
             reps = list(self._replicas)
             parked = len(self._pending)
             inflight = len(self._inflight_reqs)
+            degraded = self._degraded
             per = []
             for r in reps:
                 per.append({
                     "rid": r.rid,
                     "state": r.state,
+                    "role": r.role,
                     "generation": r.generation,
                     "health_score": _score(r),
                     "ewma_latency_ms": r.ewma_ms,
@@ -492,9 +570,108 @@ class ReplicaFleet:
                             "rejected": self.admission.rejected,
                             "max_pending": self.admission.max_pending}
         out["replicas"] = per
+        # disaggregation keys append AFTER the legacy set
+        out["tier_handoffs"] = int(self._m_tier_handoffs.value)
+        out["degraded_submits"] = int(self._m_degraded_submits.value)
+        out["degraded_mode"] = degraded
+        if self._tiered:
+            tiers: Dict[str, dict] = {}
+            for blk, r in zip(per, reps):
+                t = tiers.setdefault(r.role, {
+                    "replicas": 0, "ready": 0, "inflight": 0,
+                    "dispatched": 0, "completed": 0, "failed": 0})
+                t["replicas"] += 1
+                t["ready"] += 1 if blk["state"] == READY else 0
+                t["inflight"] += blk["inflight"]
+                t["dispatched"] += blk["dispatched"]
+                t["completed"] += blk["completed"]
+                t["failed"] += blk["failed"]
+            out["tiers"] = tiers
         return out
 
+    # -- per-tier levers (autoscaler surface) --------------------------
+
+    def tier_replicas(self, role: str) -> List[Any]:
+        """READY servers currently filling ``role`` (exact match)."""
+        with self._cond:
+            return [r.server for r in self._replicas
+                    if r.role == role and r.state == READY]
+
+    def tier_stats(self, role: str) -> dict:
+        """Aggregate queue/outcome counters over one tier's READY
+        replica servers — the observation surface for a per-tier
+        ``FleetTierTarget`` autoscaler lever. Server stats() calls take
+        server locks, so this never holds ``_cond`` across them."""
+        out = {"replicas": 0, "queued": 0, "expired": 0, "completed": 0,
+               "active_slots": 0, "slots": 0}
+        for server in self.tier_replicas(role):
+            try:
+                st = server.stats()
+            except Exception:
+                continue
+            out["replicas"] += 1
+            for k in ("queued", "expired", "completed", "slots"):
+                out[k] += st.get(k, 0)
+            out["active_slots"] += getattr(server, "active_slot_cap", 0)
+        return out
+
+    def set_tier_active_slots(self, role: str, n: int) -> int:
+        """Set the active-slot admission cap on every READY replica of
+        one tier (the per-tier scaling lever: prefill and decode
+        capacity move independently). Returns the applied per-replica
+        cap, or 0 when the tier has no capable READY replica."""
+        applied = 0
+        for server in self.tier_replicas(role):
+            if hasattr(server, "set_active_slots"):
+                applied = server.set_active_slots(n)
+        return applied
+
     # -- routing core (hot path) ---------------------------------------
+
+    def _tier_route(self, freq: _FleetRequest,
+                    skip: set) -> Tuple[List[_Replica], bool]:
+        """Tier-aware candidate filter (called under ``_cond``; on the
+        graftcheck hot list — no host-sync coercions here). Stage 1 (no
+        snapshot staged) prefers prefill-capable replicas; stage 2
+        (snapshot in hand) prefers decode-capable ones. When the decode
+        tier has no READY replica anywhere, flips degraded mode and
+        returns ``colocate=True`` for fresh requests so the dispatch
+        pins ``export_kv=False`` — co-located serving on the prefill
+        tier instead of exporting snapshots nobody can adopt. A dark
+        *preferred* tier otherwise degrades to any READY replica."""
+        ready = [r for r in self._replicas
+                 if r.state == READY and r.rid not in skip
+                 and r.rid not in freq.active]
+        if not self._tiered:
+            return ready, False
+        stage2 = freq.snapshot is not None
+        want = ("decode", "unified") if stage2 else ("prefill", "unified")
+        cands = [r for r in ready if r.role in want]
+        # tier darkness is fleet-wide readiness, not the skip-filtered
+        # view: a replica we merely already tried must not fake a dark
+        # tier
+        decode_dark = not any(
+            r.state == READY and r.role in ("decode", "unified")
+            for r in self._replicas)
+        if decode_dark:
+            self._note_degraded(True)
+        if not cands:
+            cands = ready  # preferred tier dark: cross-tier fallback
+        return cands, decode_dark and not stage2
+
+    def _note_degraded(self, dark: bool) -> None:
+        """Flip the degraded-mode flag (``_cond`` held). The typed
+        transition log fires once per flip, not once per request."""
+        if dark == self._degraded:
+            return
+        self._degraded = dark
+        if dark:
+            log.warning(
+                "fleet degraded mode ENTERED: decode tier has no READY "
+                "replica; serving co-located on the prefill tier")
+        else:
+            log.warning(
+                "fleet degraded mode cleared: decode tier healthy again")
 
     def _route_once(self, freq: _FleetRequest,
                     hedge: bool = False) -> Tuple[bool, str]:
@@ -517,10 +694,9 @@ class ReplicaFleet:
                 else:
                     expired = False
                 rep = None
+                colocate = False
                 if not expired:
-                    cands = [r for r in self._replicas
-                             if r.state == READY and r.rid not in skip
-                             and r.rid not in freq.active]
+                    cands, colocate = self._tier_route(freq, skip)
                     if cands:
                         fresh = [r for r in cands
                                  if r.rid not in freq.tried]
@@ -563,6 +739,13 @@ class ReplicaFleet:
             t0 = time.monotonic()
             with self._cond:
                 snap = freq.snapshot
+            # degraded-mode dispatch onto the prefill tier: fresh
+            # requests are pinned export_kv=False (serve co-located,
+            # don't export snapshots nobody can adopt) and staged
+            # snapshots adopt in place (adoption always decodes to
+            # completion)
+            colocated = (self._tiered and rep.role == "prefill"
+                         and (colocate or snap is not None))
             inner = None
             if snap is not None and hasattr(rep.server, "adopt_request"):
                 # crash-durable failover: resume from the newest
@@ -603,9 +786,12 @@ class ReplicaFleet:
             if inner is None:
                 try:
                     kwargs = freq.kwargs
-                    if freq.deadline is not None:
+                    if freq.deadline is not None or colocated:
                         kwargs = dict(kwargs)
-                        kwargs["deadline_s"] = rem
+                        if freq.deadline is not None:
+                            kwargs["deadline_s"] = rem
+                        if colocated:
+                            kwargs["export_kv"] = False
                     inner = rep.server.submit(*freq.args, **kwargs)
                 except ValueError:
                     rep.admission.release()
@@ -633,6 +819,8 @@ class ReplicaFleet:
                     freq.hedges += 1
             if hedge:
                 self._m_hedged.inc()
+            if colocated:
+                self._m_degraded_submits.inc()
             # if `inner` is already done this fires the callback inline
             inner.add_done_callback(
                 functools.partial(self._replica_done, freq, rep, t0))
@@ -691,7 +879,20 @@ class ReplicaFleet:
             return
         if exc is None:
             rep.breaker.record_success()
-            self._resolve(freq, fut.result(), None)
+            result = fut.result()
+            if self._tiered and isinstance(result, KVSnapshot):
+                # stage 1 of the tier pipeline complete: the prefill
+                # replica exported the request as a snapshot — stage it
+                # for the decode tier instead of resolving the caller
+                self._stage_handoff(freq, fut, result)
+                return
+            self._note_first_token(freq, fut)
+            with self._cond:
+                tfirst = freq.t_first
+            if tfirst and hasattr(result, "__len__") and len(result) > 1:
+                self.itl_hist.observe((time.monotonic() - tfirst)
+                                      * 1000.0 / (len(result) - 1))
+            self._resolve(freq, result, None)
             return
         rep.breaker.record_failure()
         if is_resolved:
@@ -722,6 +923,47 @@ class ReplicaFleet:
             self._m_redispatched.inc()
             return
         self._resolve(freq, None, exc)
+
+    def _note_first_token(self, freq: _FleetRequest, fut: Future) -> None:
+        """Record TTFT once per request, the first time the fleet learns
+        a token exists — off the replica's ``_t_first`` monotonic stamp
+        (snapshot handoff or final completion). Futures without a stamp
+        (adoption resumes, inference servers) never observe: their first
+        token predates this attempt or doesn't exist."""
+        tf = getattr(fut, "_t_first", None)
+        if tf is None:
+            return
+        with self._cond:
+            if freq.t_first:
+                return
+            freq.t_first = tf
+            t_submit = freq.t_submit
+        self.ttft_hist.observe((tf - t_submit) * 1000.0)
+
+    def _stage_handoff(self, freq: _FleetRequest, fut: Future,
+                       snap: KVSnapshot) -> None:
+        """Stage 2 of the tier pipeline: a prefill attempt resolved to a
+        KVSnapshot instead of tokens. Record TTFT (the first token is in
+        the snapshot), stash the snapshot, and park the request for the
+        monitor to route onto the decode tier — behind the same caller
+        Future, which only ever resolves with final tokens. May run
+        inline under the prefill server's lock: takes only ``_cond``."""
+        self._note_first_token(freq, fut)
+        with self._cond:
+            parked = not freq.resolved and not self._stop
+            if parked:
+                if (freq.snapshot is None
+                        or snap.count > freq.snapshot.count):
+                    freq.snapshot = snap
+                if freq not in self._pending:  # hedge twin staged first
+                    self._pending.append(freq)
+                self._cond.notify_all()
+        if parked:
+            self._m_tier_handoffs.inc()
+            return
+        if not freq.resolved:
+            self._resolve(freq, None, RuntimeError(
+                "ReplicaFleet stopped with the request mid-handoff"))
 
     def _resolve(self, freq: _FleetRequest, value: Any,
                  exc: Optional[BaseException], *,
@@ -781,6 +1023,13 @@ class ReplicaFleet:
                         if r.state == DEAD and r.restart_at <= now:
                             r.state = SPAWNING
                             spawn.append(r.rid)
+                if self._tiered and self._degraded and any(
+                        r.state == READY
+                        and r.role in ("decode", "unified")
+                        for r in self._replicas):
+                    # a decode-capable replica healed: leave degraded
+                    # mode; new work flows through the tier pipeline
+                    self._note_degraded(False)
                 hedges = []
                 if self._hedge_after_s is not None:
                     for freq in self._inflight_reqs:

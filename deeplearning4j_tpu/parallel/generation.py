@@ -54,6 +54,7 @@ import threading
 import time
 from collections import OrderedDict, deque
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeout
 from typing import Optional
 
 import numpy as np
@@ -67,7 +68,8 @@ from deeplearning4j_tpu.parallel.handoff import (WIRE_VERSION, KVSnapshot,
                                                  SnapshotUnsupported,
                                                  corrupt_snapshot,
                                                  pack_snapshot,
-                                                 padded_payload)
+                                                 padded_payload,
+                                                 truncate_snapshot)
 from deeplearning4j_tpu.parallel.resilience import (AdmissionController,
                                                     ChaosPolicy,
                                                     CircuitBreaker,
@@ -86,7 +88,7 @@ GARBAGE_PAGE = 0
 class _Request:
     __slots__ = ("prompt", "max_tokens", "temperature", "top_k", "seed",
                  "eos_id", "deadline", "future", "tokens", "t_submit",
-                 "snapshot")
+                 "snapshot", "export_kv")
 
     def __init__(self, prompt, max_tokens, temperature, top_k, seed,
                  eos_id, deadline):
@@ -103,6 +105,9 @@ class _Request:
         # a KVSnapshot to resume from instead of prefilling from token 0
         # (set by adopt_request and by a preemption that saved its state)
         self.snapshot = None
+        # disaggregated prefill tier: after prefill, export the slot as
+        # a KVSnapshot (the future's RESULT) instead of decoding here
+        self.export_kv = False
 
 
 class _PagePool:
@@ -232,6 +237,7 @@ class GenerationServer:
                  draft_net=None,
                  spec_k: int = 4,
                  snapshot_every: int = 0,
+                 role: str = "unified",
                  retry: Optional[RetryPolicy] = None,
                  breaker: Optional[CircuitBreaker] = None,
                  chaos: Optional[ChaosPolicy] = None,
@@ -272,6 +278,22 @@ class GenerationServer:
                 "snapshot_every is incompatible with draft_net: the "
                 "speculative draft's dense KV cache is not part of the "
                 "KVSnapshot wire format")
+        # disaggregated serving tier. "prefill": submits default to
+        # export_kv=True — chunked wave prefill runs to completion, then
+        # the request ships out as a KVSnapshot (the future's result)
+        # instead of entering the decode loop. "decode": a tier label
+        # for routers; the server itself serves adoptions AND plain
+        # submits (the token-0 fallback target). "unified": classic
+        # co-located serving.
+        if role not in ("unified", "prefill", "decode"):
+            raise ValueError(f"role must be 'unified', 'prefill' or "
+                             f"'decode', got {role!r}")
+        if role == "prefill" and draft_net is not None:
+            raise ValueError(
+                "role='prefill' is incompatible with draft_net: the "
+                "exported KVSnapshot cannot carry the draft's dense "
+                "KV cache")
+        self.role = role
         self.admission = AdmissionController(max_pending)
         self.retry = retry if retry is not None else RetryPolicy()
         self.breaker = breaker if breaker is not None else CircuitBreaker()
@@ -409,6 +431,10 @@ class GenerationServer:
         self._m_migrated = m.counter(
             "generation_handoff_migrated_total",
             "requests migrated off this server by drain(migrate=...)")
+        self._m_prefill_exports = m.counter(
+            "generation_prefill_exports_total",
+            "requests exported as KVSnapshots after prefill "
+            "(disaggregated prefill tier)")
         m.gauge("generation_slots", "decode slot pool size",
                 fn=lambda: self.slots)
         m.gauge("generation_active_slots", "slots currently decoding",
@@ -1011,14 +1037,23 @@ class GenerationServer:
     # ------------------------------------------------------------- submit
     def submit(self, prompt_ids, max_tokens: int, *,
                temperature: float = 0.0, top_k: int = 0, seed: int = 0,
-               eos_id=_UNSET, deadline_s: Optional[float] = None) -> Future:
+               eos_id=_UNSET, deadline_s: Optional[float] = None,
+               export_kv: Optional[bool] = None) -> Future:
         """Queue one generation request; returns a Future resolving to
         the generated ids ([<= max_tokens] numpy int array — shorter when
         the per-request ``eos_id`` / server default is produced, which is
         included). Raises a typed ``ServerOverloaded`` when the request
         cannot fit the page budget (up front — never mid-prefill after a
         slot is consumed) or past the admission watermark, and
-        ``CircuitOpen`` while dispatches are failing."""
+        ``CircuitOpen`` while dispatches are failing.
+
+        ``export_kv`` selects the disaggregated-prefill outcome: True
+        resolves the future to a ``KVSnapshot`` right after prefill
+        (first token included in its header) for a decode-tier server
+        to adopt; False decodes to completion here. The default (None)
+        follows the server ``role`` — True on a prefill-role server,
+        False otherwise — so a degraded fleet can co-locate decode on
+        the prefill tier by passing ``export_kv=False`` explicitly."""
         prompt = np.asarray(prompt_ids)
         if prompt.ndim != 1 or prompt.shape[0] < 1:
             raise ValueError(f"prompt_ids must be a non-empty 1-D id "
@@ -1063,6 +1098,15 @@ class GenerationServer:
                        float(temperature), int(top_k), int(seed),
                        self.eos_id if eos_id is _UNSET else eos_id,
                        None if budget is None else Deadline(budget))
+        req.export_kv = (self.role == "prefill") if export_kv is None \
+            else bool(export_kv)
+        if req.export_kv and self._draft is not None:
+            raise SnapshotUnsupported(
+                "speculative servers cannot export: the draft's dense "
+                "KV cache is not part of the KVSnapshot wire format")
+        # export_request / the fleet clamp their waits to the request's
+        # own remaining budget through this stamp
+        req.future._deadline = req.deadline
         self.admission.acquire()  # raises ServerOverloaded at watermark
         req.future.add_done_callback(lambda _f: self.admission.release())
         with self._cond:
@@ -1483,6 +1527,13 @@ class GenerationServer:
                     self._fail(req, e)
                     continue
             self._commit_slot(s, req, plen, first[s], keys[s], t0)
+        # disaggregated prefill: export the wave's export_kv slots that
+        # are still live (a request that finished on its first token was
+        # already retired with a complete result — no handoff needed)
+        exports = [(s, req) for s, req, *_ in group
+                   if req.export_kv and self._slot_req[s] is req]
+        if exports:
+            self._transfer_loop(exports)
 
     def _commit_slot(self, slot: int, req: _Request, plen: int, tok,
                      key, t0: float):
@@ -1499,6 +1550,9 @@ class GenerationServer:
         self._keys[slot] = key
         self._pos[slot] = plen
         req.tokens.append(tok)
+        # TTFT stamp: the first token exists NOW, even when the request
+        # later crosses the tier boundary (fleet histograms read this)
+        req.future._t_first = time.monotonic()
         self._admit_seq += 1
         self._slot_seq[slot] = self._admit_seq
         with self._cond:
@@ -1766,12 +1820,73 @@ class GenerationServer:
         """Count the export, run the chaos injector, and attach the
         snapshot to the request's future — the transport: whoever holds
         the future (the fleet's done-callback, a migration driver) reads
-        ``future._kv_snapshot`` when the request fails mid-stream."""
-        if self._chaos is not None and self._chaos.handoff_fault():
+        ``future._kv_snapshot`` when the request fails mid-stream. An
+        injected ``drop`` makes the transfer vanish (nothing attached —
+        the consumer falls back to whatever it already had); ``corrupt``
+        and ``truncate`` damage the wire content so the adopter's
+        checksum fails."""
+        mode = None if self._chaos is None \
+            else self._chaos.handoff_fault_mode()
+        if mode == "drop":
+            return
+        if mode == "corrupt":
             corrupt_snapshot(snap)
+        elif mode == "truncate":
+            truncate_snapshot(snap)
         self._m_handoff_snapshots.inc()
         self._m_handoff_bytes.inc(snap.wire_bytes())
         req.future._kv_snapshot = snap
+
+    def _transfer_loop(self, exports):
+        """Disaggregated-prefill transfer: ship each freshly prefilled
+        ``export_kv`` slot across the tier boundary — the future
+        resolves to the ``KVSnapshot`` itself, the slot's pages free
+        immediately (this is where the prefill tier's short slot
+        residency comes from), and a decode-tier server adopts the
+        snapshot to stream the rest. Failure never loses the request: a
+        snapshot failure degrades to co-located decode in this server's
+        own loop, and an injected transfer drop fails the future typed
+        (``SnapshotUnavailable``, no snapshot attached) so a fleet
+        re-prefills on a sibling. Loop-thread only; on the graftcheck
+        hot list, so scalar host syncs stay in ``pack_snapshot``."""
+        for slot, req in exports:
+            if self._slot_req[slot] is not req:
+                continue  # retired/expired between commit and transfer
+            try:
+                snap = self._snapshot_slot(slot)
+            except Exception:  # noqa: BLE001 — degrade to co-located
+                # decode: the slot stays active and this server streams
+                # the completion itself (always correct, never lost)
+                self._m_handoff_fallbacks.inc()
+                continue
+            mode = None if self._chaos is None \
+                else self._chaos.handoff_fault_mode()
+            if mode == "corrupt":
+                corrupt_snapshot(snap)
+            elif mode == "truncate":
+                truncate_snapshot(snap)
+            self._release_slot_pages(slot)
+            with self._cond:
+                self._slot_req[slot] = None
+                self._n_active -= 1
+                self._cond.notify_all()
+            if mode == "drop":
+                # the transfer vanished in flight: fail typed WITHOUT a
+                # snapshot attached — the consumer re-runs the prefill
+                # elsewhere (zero lost futures, some recompute)
+                self._m_failed.inc()
+                self._fail(req, SnapshotUnavailable(
+                    "handoff transfer dropped in flight"))
+                continue
+            self._m_handoff_snapshots.inc()
+            self._m_handoff_bytes.inc(snap.wire_bytes())
+            self._m_prefill_exports.inc()
+            self._m_retired.inc()
+            self._m_completed.inc()
+            try:
+                req.future.set_result(snap)
+            except Exception:  # caller gave up
+                pass
 
     def _maybe_snapshot_slots(self):
         """Periodic low-priority snapshotting: at most ONE slot per loop
@@ -1842,20 +1957,39 @@ class GenerationServer:
                        ) -> KVSnapshot:
         """Snapshot the live request behind ``future`` (as returned by
         ``submit``). Blocks until the serving loop services the export
-        between dispatches. Raises ``SnapshotUnavailable`` when the
-        request is not resident in a slot, ``SnapshotUnsupported`` on a
-        speculative server."""
+        between dispatches — never longer than the request's OWN
+        remaining deadline budget: the wait is
+        ``min(timeout, deadline.remaining())`` and expiry raises the
+        typed ``DeadlineExceeded``, not a generic timeout. Raises
+        ``SnapshotUnavailable`` when the request is not resident in a
+        slot, ``SnapshotUnsupported`` on a speculative server."""
         if self._draft is not None:
             raise SnapshotUnsupported(
                 "speculative servers cannot export: the draft's dense "
                 "KV cache is not part of the KVSnapshot wire format")
+        deadline = getattr(future, "_deadline", None)
+        eff = timeout
+        if deadline is not None:
+            rem = deadline.remaining()
+            if rem <= 0:
+                raise DeadlineExceeded(
+                    "request budget exhausted before the export "
+                    f"({-rem * 1e3:.1f} ms over)")
+            eff = rem if eff is None else min(eff, rem)
         out: Future = Future()
         with self._cond:
             if self._closing:
                 raise RuntimeError("GenerationServer is closed")
             self._export_q.append((future, out))
             self._cond.notify_all()
-        return out.result(timeout=timeout)
+        try:
+            return out.result(timeout=eff)
+        except FutureTimeout:
+            if deadline is not None and deadline.expired():
+                raise DeadlineExceeded(
+                    "request budget exhausted waiting for the export "
+                    f"({-deadline.remaining() * 1e3:.1f} ms over)")
+            raise
 
     def adopt_request(self, snapshot: KVSnapshot, *,
                       deadline_s: Optional[float] = None) -> Future:
@@ -1908,14 +2042,23 @@ class GenerationServer:
         if not self.breaker.allow():
             raise CircuitOpen("circuit breaker is open: recent decode "
                               "dispatches failed above threshold")
-        budget = deadline_s if deadline_s is not None \
-            else self.request_deadline_s
+        # remaining-budget propagation across the tier boundary: an
+        # explicit deadline_s wins, then the remaining budget the
+        # snapshot carried from the exporting server (a duration — it
+        # re-arms here against THIS host's monotonic clock), then this
+        # server's default
+        budget = deadline_s
+        if budget is None:
+            budget = snapshot.deadline_remaining
+        if budget is None:
+            budget = self.request_deadline_s
         req = _Request(snapshot.prompt.astype(np.int64),
                        snapshot.max_tokens, snapshot.temperature,
                        snapshot.top_k, snapshot.seed, snapshot.eos_id,
                        None if budget is None else Deadline(budget))
         req.tokens = list(snapshot.tokens)
         req.snapshot = snapshot
+        req.future._deadline = req.deadline
         self.admission.acquire()  # raises ServerOverloaded at watermark
         req.future.add_done_callback(lambda _f: self.admission.release())
         with self._cond:
@@ -2181,7 +2324,9 @@ class GenerationServer:
             "fallbacks": int(self._m_handoff_fallbacks.value),
             "preempt_resumes": int(self._m_preempt_resumes.value),
             "migrated": int(self._m_migrated.value),
+            "prefill_exports": int(self._m_prefill_exports.value),
         }
+        out["role"] = self.role
         # the admission ledger must agree with the bytes XLA actually
         # allocated for the pool — satellite guard for the itemsize fix
         assert self._page_bytes_actual == self._page_bytes, (

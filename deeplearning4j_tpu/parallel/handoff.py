@@ -49,7 +49,10 @@ from deeplearning4j_tpu.parallel.resilience import ResilienceError
 
 #: KVSnapshot wire-format version. Bump on any layout change; adopters
 #: refuse versions they do not speak (typed ``SnapshotInvalid``).
-WIRE_VERSION = 1
+#: v2: ``deadline_remaining`` joined the resume header — the request's
+#: remaining Deadline budget in seconds (never an absolute timestamp, so
+#: the field survives wall-clock skew between exporter and adopter).
+WIRE_VERSION = 2
 
 _MAGIC = b"KVSN"
 
@@ -104,12 +107,13 @@ class KVSnapshot:
     __slots__ = ("version", "prompt", "tokens", "pos", "count", "last",
                  "key", "temperature", "top_k", "seed", "eos_id",
                  "max_tokens", "kv_dtype", "page_size",
-                 "page_token_bytes", "page_digests", "payload", "checksum")
+                 "page_token_bytes", "page_digests", "payload",
+                 "deadline_remaining", "checksum")
 
     def __init__(self, *, version, prompt, tokens, pos, count, last, key,
                  temperature, top_k, seed, eos_id, max_tokens, kv_dtype,
                  page_size, page_token_bytes, page_digests, payload,
-                 checksum=None):
+                 deadline_remaining=None, checksum=None):
         self.version = int(version)
         self.prompt = np.asarray(prompt, np.int64)
         self.tokens = [int(t) for t in tokens]
@@ -127,6 +131,12 @@ class KVSnapshot:
         self.page_token_bytes = int(page_token_bytes)
         self.page_digests: List[Optional[bytes]] = list(page_digests)
         self.payload = payload
+        #: remaining request Deadline budget (seconds) at pack time — a
+        #: duration, not a timestamp, so adoption on another host with a
+        #: skewed wall clock re-arms the same budget (monotonic-deadline
+        #: rule). None = the request carried no deadline.
+        self.deadline_remaining = None if deadline_remaining is None \
+            else float(deadline_remaining)
         self.checksum = checksum if checksum is not None \
             else self.content_digest()
 
@@ -148,6 +158,7 @@ class KVSnapshot:
             "kv_dtype": self.kv_dtype,
             "page_size": self.page_size,
             "page_token_bytes": self.page_token_bytes,
+            "deadline_remaining": self.deadline_remaining,
             "page_digests": [None if d is None else d.hex()
                              for d in self.page_digests],
             "leaves": [[vn, leaf, str(a.dtype), list(a.shape)]
@@ -225,7 +236,9 @@ class KVSnapshot:
             page_token_bytes=hdr["page_token_bytes"],
             page_digests=[None if d is None else bytes.fromhex(d)
                           for d in hdr["page_digests"]],
-            payload=payload, checksum=checksum)
+            payload=payload,
+            deadline_remaining=hdr["deadline_remaining"],
+            checksum=checksum)
         if not snap.verify():
             raise SnapshotInvalid("KVSnapshot checksum mismatch")
         return snap
@@ -239,18 +252,23 @@ def pack_snapshot(*, req, pos, count, last, key, kv_dtype, page_size,
     fetch ``{vertex: {leaf: [NP, ...]}}``; only the first ``n_pages``
     rows hold this slot's resident KV. Every host conversion (int casts,
     list copies, array slices) happens HERE, outside the serving loop's
-    hot-named functions."""
+    hot-named functions. The request's remaining Deadline budget is
+    captured as a duration so the adopter re-arms the same clock."""
     n = int(n_pages)
     payload = {vn: {leaf: np.ascontiguousarray(a[:n])
                     for leaf, a in leaves.items()}
                for vn, leaves in fetched.items()}
+    deadline = getattr(req, "deadline", None)
+    remaining = None if deadline is None else max(0.0,
+                                                 deadline.remaining())
     return KVSnapshot(
         version=WIRE_VERSION, prompt=req.prompt, tokens=list(req.tokens),
         pos=pos, count=count, last=last, key=key,
         temperature=req.temperature, top_k=req.top_k, seed=req.seed,
         eos_id=req.eos_id, max_tokens=req.max_tokens, kv_dtype=kv_dtype,
         page_size=page_size, page_token_bytes=page_token_bytes,
-        page_digests=list(page_digests)[:n], payload=payload)
+        page_digests=list(page_digests)[:n], payload=payload,
+        deadline_remaining=remaining)
 
 
 def padded_payload(snap: KVSnapshot, np_pages: int
@@ -283,6 +301,29 @@ def corrupt_snapshot(snap: KVSnapshot) -> KVSnapshot:
             return snap
     # pathological empty payload: break the checksum directly
     snap.checksum = bytes(32)
+    return snap
+
+
+def truncate_snapshot(snap: KVSnapshot) -> KVSnapshot:
+    """Zero the tail half of the last payload leaf *after* the checksum
+    was computed — the chaos injector's ``handoff_truncate`` mode: the
+    wire analog of a transfer cut short, where the missing tail reads
+    back as zeros and the adopter's ``verify()`` fails before any page
+    lands in its pool. Returns the same (now invalid) snapshot."""
+    last_leaf = None
+    for vn, leaf, a in _leaf_items(snap.payload):
+        if a.size:
+            last_leaf = (vn, leaf, a)
+    if last_leaf is None:
+        snap.checksum = bytes(32)
+        return snap
+    vn, leaf, a = last_leaf
+    b = np.array(a)  # device fetches / frombuffer views are read-only
+    flat = b.view(np.uint8).reshape(-1)
+    flat[flat.size // 2:] = 0
+    if np.array_equal(b, a):
+        flat[-1] ^= 0xFF  # tail was already zeros: still break content
+    snap.payload[vn][leaf] = b
     return snap
 
 

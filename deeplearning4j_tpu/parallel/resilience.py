@@ -318,10 +318,19 @@ class ChaosPolicy:
       regeneration.
     - ``handoff_stall_rate``/``handoff_stall_s``: the snapshot path
       freezes for ``handoff_stall_s`` — a slow migration wire.
+    - ``handoff_drop_rate``: the transfer vanishes in flight — the
+      snapshot is never published/shipped, so the consumer sees a typed
+      ``SnapshotUnavailable`` failure and re-runs the work elsewhere.
+    - ``handoff_truncate_rate``: the transfer is cut short — the wire
+      tail reads back as zeros, so the adopter's checksum ``verify()``
+      fails and it falls back to token-0 regeneration.
 
-    ``handoff_fault()`` draws from the shared rng only when one of the
-    handoff rates is non-zero, so legacy wrap() sequences are
-    reproduced bit-for-bit even on servers that call it every loop."""
+    ``handoff_fault()``/``handoff_fault_mode()`` draw from the shared
+    rng only when one of the handoff rates is non-zero, so legacy
+    wrap() sequences are reproduced bit-for-bit even on servers that
+    call it every loop; the new drop/truncate rates gate the draw the
+    same way, so pre-existing handoff fault sequences (corrupt/stall
+    only) also stay pinned."""
 
     def __init__(self, seed: int = 0, transient_rate: float = 0.0,
                  hard_rate: float = 0.0, latency_s: float = 0.0,
@@ -332,6 +341,8 @@ class ChaosPolicy:
                  snapshot_corrupt_rate: float = 0.0,
                  handoff_stall_rate: float = 0.0,
                  handoff_stall_s: float = 0.0,
+                 handoff_drop_rate: float = 0.0,
+                 handoff_truncate_rate: float = 0.0,
                  sleep: Callable[[float], None] = time.sleep):
         self.transient_rate = float(transient_rate)
         self.hard_rate = float(hard_rate)
@@ -345,6 +356,8 @@ class ChaosPolicy:
         self.snapshot_corrupt_rate = float(snapshot_corrupt_rate)
         self.handoff_stall_rate = float(handoff_stall_rate)
         self.handoff_stall_s = float(handoff_stall_s)
+        self.handoff_drop_rate = float(handoff_drop_rate)
+        self.handoff_truncate_rate = float(handoff_truncate_rate)
         self._sleep = sleep
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
@@ -356,29 +369,55 @@ class ChaosPolicy:
         self.injected_slow = 0
         self.injected_snapshot_corrupt = 0
         self.injected_handoff_stall = 0
+        self.injected_handoff_drop = 0
+        self.injected_handoff_truncate = 0
 
     def handoff_fault(self) -> bool:
+        """Legacy boolean form of ``handoff_fault_mode()``: returns True
+        iff the snapshot should be corrupted (the only mode the PR-11
+        consumers knew). Same single draw, same counters."""
+        return self.handoff_fault_mode() == "corrupt"
+
+    def handoff_fault_mode(self) -> Optional[str]:
         """One seeded draw per snapshot shipped (and only when a handoff
-        rate is non-zero, so wrap() sequences stay pinned). Performs the
-        ``handoff_stall`` sleep itself, outside the rng lock; returns
-        True iff the snapshot should be corrupted. The two modes are
-        mutually exclusive per draw, stacked corrupt-then-stall like the
-        replica modes."""
-        if not (self.snapshot_corrupt_rate or self.handoff_stall_rate):
-            return False
+        rate is non-zero, so wrap() sequences stay pinned — and legacy
+        corrupt/stall thresholds stay pinned when the new rates are 0).
+        Performs the ``handoff_stall`` sleep itself, outside the rng
+        lock. Returns the injected fault mode — ``"corrupt"``,
+        ``"drop"``, or ``"truncate"`` — or None (a stall delays the
+        transfer but does not damage it). The modes are mutually
+        exclusive per draw, stacked corrupt-then-stall-then-drop-then-
+        truncate like the replica modes."""
+        if not (self.snapshot_corrupt_rate or self.handoff_stall_rate
+                or self.handoff_drop_rate or self.handoff_truncate_rate):
+            return None
         with self._lock:
             r = self._rng.random()
-            corrupt = r < self.snapshot_corrupt_rate
-            stall = (not corrupt
-                     and r < (self.snapshot_corrupt_rate
-                              + self.handoff_stall_rate))
+            t = self.snapshot_corrupt_rate
+            corrupt = r < t
+            t += self.handoff_stall_rate
+            stall = not corrupt and r < t
+            t += self.handoff_drop_rate
+            drop = not (corrupt or stall) and r < t
+            t += self.handoff_truncate_rate
+            truncate = not (corrupt or stall or drop) and r < t
             if corrupt:
                 self.injected_snapshot_corrupt += 1
             if stall:
                 self.injected_handoff_stall += 1
+            if drop:
+                self.injected_handoff_drop += 1
+            if truncate:
+                self.injected_handoff_truncate += 1
         if stall:
             self._sleep(self.handoff_stall_s)
-        return corrupt
+        if corrupt:
+            return "corrupt"
+        if drop:
+            return "drop"
+        if truncate:
+            return "truncate"
+        return None
 
     def wrap(self, fn: Callable) -> Callable:
         """The chaotic twin of ``fn``: same signature, same result, but

@@ -175,6 +175,13 @@ def pytest_configure(config):
         "round-trip bit-exactness, corrupted-checksum fallback, "
         "mid-stream failover resume, drain-migrate — CPU-fast; runs in "
         "tier-1, deliberately NOT in the slow set)")
+    config.addinivalue_line(
+        "markers",
+        "disagg: disaggregated prefill/decode tier tests (prefill-export "
+        "-> decode-adopt bit-exactness, mid-handoff kills on each side, "
+        "corrupt/drop/truncate transfer fallback, decode-tier-dark "
+        "degraded mode + recovery — CPU-fast; runs in tier-1, "
+        "deliberately NOT in the slow set)")
 
 
 @pytest.fixture(autouse=True)
@@ -190,7 +197,8 @@ def _lock_order_debug(request):
             or request.node.get_closest_marker("fleet")
             or request.node.get_closest_marker("metrics")
             or request.node.get_closest_marker("quant")
-            or request.node.get_closest_marker("handoff")):
+            or request.node.get_closest_marker("handoff")
+            or request.node.get_closest_marker("disagg")):
         yield
         return
     from deeplearning4j_tpu.analysis import instrument

@@ -418,10 +418,10 @@ GEN_KEYS = ["slots", "active_slots", "queued", "admitted", "expired",
             "retired", "completed", "failed", "retried", "pool_rebuilds",
             "prefills", "decode_steps", "tokens_generated", "tokens_per_s",
             "accepted", "rejected", "pending", "breaker_state", "pages",
-            "handoff"]
+            "handoff", "role"]
 GEN_HANDOFF_KEYS = ["snapshot_every", "snapshots", "bytes", "resumes",
                     "tokens_saved", "fallbacks", "preempt_resumes",
-                    "migrated"]
+                    "migrated", "prefill_exports"]
 GEN_PAGE_KEYS = ["page_size", "pages_total", "pages_free", "pages_cached",
                  "pages_shared", "pages_refcounted", "resident_kv_bytes",
                  "peak_resident_kv_bytes", "cow_copies", "prefix_hits",
@@ -434,8 +434,9 @@ FLEET_KEYS = ["replica_count", "submitted", "rejected_submits", "completed",
               "failed", "expired", "redispatched", "hedged",
               "losers_cancelled", "deaths", "restarts", "parked", "inflight",
               "handoff_resumes", "handoff_fallbacks",
-              "admission", "replicas"]
-FLEET_REPLICA_KEYS = ["rid", "state", "generation", "health_score",
+              "admission", "replicas", "tier_handoffs", "degraded_submits",
+              "degraded_mode"]
+FLEET_REPLICA_KEYS = ["rid", "state", "role", "generation", "health_score",
                       "ewma_latency_ms", "failure_ewma", "inflight",
                       "restarts", "spawn_failures", "dispatched", "completed",
                       "failed", "rejected", "breaker", "breaker_trips",
