@@ -1653,6 +1653,176 @@ def bench_serve_soak(duration_s: float = 8.0, lo: float = 1200.0,
     }
 
 
+def bench_serve_restart(n_requests: int = 72, vocab: int = 17,
+                        rate_req_s: float = 120.0, seed: int = 0):
+    """Rolling supervised restart under load: a two-replica generation
+    fleet serves a seeded Poisson arrival stream while one replica's
+    decode loop thread is KILLED in place mid-stream (chaos lands a
+    ``LoopKilled`` during a drain-migrate pass) and the runtime's
+    ``LoopSupervisor`` restarts the same server — no fleet respawn, no
+    replacement replica, the rolling-restart primitive the unified
+    runtime exists to make safe.
+
+    Three gates, all in-bench:
+
+    * zero lost futures — the fleet parks the victim's in-flight work
+      and redispatches it, so every accepted request completes; the
+      ledger (submitted == completed + rejected_submits, nothing left
+      in flight / parked / failed / expired) is asserted from the fleet
+      counters;
+    * bit-exact completions — every output matches its serial greedy
+      reference, across the redispatch (the fold_in key schedule makes
+      regeneration exact on any replica);
+    * bounded tail — latency is measured from the SCHEDULED Poisson
+      arrival (no coordinated omission), and the restart pass's p99
+      must stay within 2x of the steady-state pass's p99 on the same
+      schedule."""
+    from deeplearning4j_tpu.models.zoo import TransformerLM, greedy_generate
+    from deeplearning4j_tpu.parallel.fleet import READY, ReplicaFleet
+    from deeplearning4j_tpu.parallel.generation import GenerationServer
+    from deeplearning4j_tpu.parallel.resilience import (ChaosPolicy,
+                                                        ResilienceError)
+
+    net = TransformerLM(num_labels=vocab, max_length=16, d_model=16,
+                        n_heads=2, n_blocks=1, seed=3).init()
+    rng = np.random.default_rng(42 + seed)
+    shapes = [(3, 4), (5, 5), (4, 6)]  # (plen, steps): bounded programs
+    specs = [(rng.integers(1, vocab,
+                           size=shapes[i % len(shapes)][0]).astype(np.int64),
+              shapes[i % len(shapes)][1])
+             for i in range(n_requests)]
+    refs = [greedy_generate(net, p[None], steps, vocab)[0]
+            for p, steps in specs]
+    gaps = rng.exponential(1.0 / rate_req_s, size=n_requests)
+
+    chaos_by_rid = {}
+
+    def factory(rid):
+        # the kill is drawn ONLY on a drain/migration pass, so steady
+        # serving is chaos-free and the two passes differ by exactly
+        # the one injected loop death
+        chaos_by_rid[rid] = ChaosPolicy(seed=1000 + rid,
+                                        kill_during_drain_rate=1.0)
+        return GenerationServer(net, vocab, slots=4,
+                                chaos=chaos_by_rid[rid])
+
+    def submit_retry(fl, spec):
+        p, steps = spec
+        t_end = time.monotonic() + SUB_BENCH_TIMEOUT_S
+        while True:
+            try:
+                return fl.submit(p, steps, deadline_s=SUB_BENCH_TIMEOUT_S)
+            except ResilienceError:
+                if time.monotonic() > t_end:
+                    raise
+                time.sleep(0.01)
+
+    def run_pass(fl, srv0, restart_mid):
+        restarts0 = srv0._runtime.restarts
+        done_at = [None] * n_requests
+        roller = None
+
+        def make_cb(i):
+            def cb(_fut):
+                done_at[i] = time.perf_counter()
+            return cb
+
+        t0 = time.perf_counter()
+        futs = []
+        sched = []
+        due = t0
+        for i, spec in enumerate(specs):
+            due += gaps[i]
+            delay = due - time.perf_counter()
+            if delay > 0:  # a lagging server never paces arrivals down
+                time.sleep(delay)
+            sched.append(due)
+            f = submit_retry(fl, spec)
+            f.add_done_callback(make_cb(i))
+            futs.append(f)
+            if restart_mid and i == n_requests // 3:
+                # in-place rolling restart: the migrate pass arms the
+                # chaos kill, the supervisor restarts the SAME server
+                roller = threading.Thread(
+                    target=lambda: srv0.drain(timeout=30, migrate=True),
+                    daemon=True)
+                roller.start()
+        outs = [f.result(timeout=SUB_BENCH_TIMEOUT_S) for f in futs]
+        total = time.perf_counter() - t0
+        if roller is not None:
+            roller.join(timeout=30)
+        bad = sum(1 for o, ref in zip(outs, refs)
+                  if not np.array_equal(np.asarray(o), ref))
+        if bad:
+            raise RuntimeError(
+                f"{bad}/{n_requests} completions differ from their serial "
+                "references across the supervised restart")
+        if restart_mid:
+            t_end = time.monotonic() + 30.0
+            while srv0._runtime.restarts <= restarts0:
+                if time.monotonic() > t_end:
+                    raise RuntimeError(
+                        "the chaos kill never produced a supervised "
+                        "restart — the rolling-restart path was not "
+                        "exercised")
+                time.sleep(0.02)
+            if chaos_by_rid[0].injected_drain_kill < 1:
+                raise RuntimeError("drain-kill chaos armed but never drew")
+        lat_ms = sorted((d - s) * 1e3 for d, s in zip(done_at, sched))
+        return total, lat_ms
+
+    fl = ReplicaFleet(factory, replicas=2, max_pending=2 * n_requests,
+                      replica_max_pending=2 * n_requests,
+                      restart_backoff_s=0.05)
+    try:
+        with fl._cond:
+            srv0 = fl._replicas[0].server
+        # warm every program on both replicas
+        run_pass(fl, srv0, restart_mid=False)
+        steady_total, steady_lat = run_pass(fl, srv0, restart_mid=False)
+        restart_total, restart_lat = run_pass(fl, srv0, restart_mid=True)
+        loop_restarts = srv0._runtime.restarts
+        # the restarted replica must be back in service before the
+        # ledger read, or in-flight bookkeeping muddies the counters
+        t_end = time.monotonic() + 30.0
+        st = fl.stats()
+        while any(r["state"] != READY for r in st["replicas"]):
+            if time.monotonic() > t_end:
+                break
+            time.sleep(0.02)
+            st = fl.stats()
+    finally:
+        fl.close()
+    lost = st["submitted"] - st["completed"] - st["rejected_submits"]
+    if lost or st["inflight"] or st["parked"] or st["failed"] \
+            or st["expired"]:
+        raise RuntimeError(
+            f"rolling restart leaked {lost} futures (inflight "
+            f"{st['inflight']}, parked {st['parked']}, failed "
+            f"{st['failed']}, expired {st['expired']})")
+    p99_steady = _serve_latency_quantiles(
+        steady_lat, "x")["x_p99_ms"]
+    p99_restart = _serve_latency_quantiles(
+        restart_lat, "x")["x_p99_ms"]
+    if p99_steady > 0 and p99_restart > 2.0 * p99_steady:
+        raise RuntimeError(
+            f"restart-pass p99 {p99_restart:.1f} ms exceeds 2x the "
+            f"steady-state p99 {p99_steady:.1f} ms — the supervised "
+            "restart is not transparent enough")
+    return {
+        "serve_restart_req_s": _sane("serve_restart_req_s",
+                                     n_requests / restart_total),
+        "serve_restart_steady_req_s": _sane(
+            "serve_restart_steady_req_s", n_requests / steady_total),
+        "serve_restart_p99_ms": p99_restart,
+        "serve_restart_steady_p99_ms": p99_steady,
+        "serve_restart_p99_ratio": (p99_restart / p99_steady
+                                    if p99_steady > 0 else 0.0),
+        "serve_restart_loop_restarts": float(loop_restarts),
+        "serve_restart_redispatched": float(st["redispatched"]),
+    }
+
+
 def bench_metrics_overhead(n_requests: int = 1024, max_batch: int = 128,
                            reps: int = 5):
     """Registry publication cost on the two hot serving paths
@@ -1868,6 +2038,8 @@ SANITY_CEILING = {
     "serve_fleet_req_s": 1e8,
     "serve_fleet_1rep_req_s": 1e8,
     "serve_handoff_req_s": 1e8,
+    "serve_restart_req_s": 1e8,
+    "serve_restart_steady_req_s": 1e8,
     "serve_disagg_req_s": 1e8,
     "serve_colo_req_s": 1e8,
     "generate_serve_tokens_s": 1e9,
@@ -1943,6 +2115,13 @@ METRIC_UNIT = {
     "serve_fleet_deaths": "",
     "serve_fleet_restarts": "",
     "serve_fleet_redispatched": "",
+    "serve_restart_req_s": "req/s",
+    "serve_restart_steady_req_s": "req/s",
+    "serve_restart_p99_ms": "ms",
+    "serve_restart_steady_p99_ms": "ms",
+    "serve_restart_p99_ratio": "x",
+    "serve_restart_loop_restarts": "",
+    "serve_restart_redispatched": "",
     "serve_handoff_req_s": "req/s",
     "serve_handoff_recompute_tokens": "tokens",
     "serve_handoff_token0_recompute_tokens": "tokens",
@@ -2212,7 +2391,7 @@ def main():
              "word2vec", "doc2vec", "attention", "fit_e2e", "eval_e2e",
              "guard_overhead", "metrics_overhead", "inference_serve",
              "serve_chaos", "serve_fleet", "serve_handoff", "serve_disagg",
-             "serve_soak",
+             "serve_soak", "serve_restart",
              "generate_serve", "generate_longtail", "quant_serve",
              "quant_infer")
     if which not in valid:
@@ -2280,6 +2459,9 @@ def main():
     if which in ("all", "serve_soak"):
         _sub_metric(extras, "serve_soak", bench_serve_soak)
         headline and headline.sample("post-serve-soak")
+    if which in ("all", "serve_restart"):
+        _sub_metric(extras, "serve_restart", bench_serve_restart)
+        headline and headline.sample("post-serve-restart")
     if which in ("all", "generate_serve"):
         _sub_metric(extras, "generate_serve", bench_generate_serve)
     if which in ("all", "generate_longtail"):

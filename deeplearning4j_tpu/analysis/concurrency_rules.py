@@ -21,6 +21,15 @@ Rules emitted by :func:`check_module`:
   jumps under NTP step/VM migration; durations and deadlines must use
   ``time.monotonic()``. Storing a wall timestamp (no arithmetic) is
   fine and not flagged.
+- ``conc-loop-ownership`` — classes may declare
+  ``_LOOP_OWNED = ("attr", ...)`` + ``_LOOP_LOCK = "lockname"`` at class
+  level: state owned by the class's loop thread (the method handed to
+  ``threading.Thread``/``ServingLoop`` as ``target=``/``tick=``/
+  ``handler=``), read lock-free on that thread between rounds. Declared
+  attributes are EXEMPT from ``conc-mixed-lock`` (the lock-free loop
+  reads are the design), and in exchange every WRITE from a method
+  reachable off the loop thread must hold the declared lock — a bare
+  off-loop write is a finding, checked instead of baselined.
 
 :func:`check_lock_graph` builds the cross-module lock-acquisition graph
 (nodes = ``(Class, lock_attr)``; edges = "acquired while holding", via
@@ -109,6 +118,8 @@ class _Class:
     locks: Set[str] = field(default_factory=set)
     attr_types: Dict[str, str] = field(default_factory=dict)
     methods: Dict[str, _Method] = field(default_factory=dict)
+    loop_owned: Tuple[str, ...] = ()      # declared _LOOP_OWNED attrs
+    loop_lock: Optional[str] = None       # declared _LOOP_LOCK name
 
 
 # --------------------------------------------------------------------------
@@ -117,6 +128,23 @@ class _Class:
 
 def _scan_class(cls_node: ast.ClassDef, path: str) -> _Class:
     info = _Class(name=cls_node.name, path=path, line=cls_node.lineno)
+
+    # pass 0: loop-ownership declarations (class-level literal assigns)
+    for stmt in cls_node.body:
+        if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)):
+            continue
+        tname = stmt.targets[0].id
+        if tname == "_LOOP_OWNED" \
+                and isinstance(stmt.value, (ast.Tuple, ast.List)):
+            info.loop_owned = tuple(
+                e.value for e in stmt.value.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, str))
+        elif tname == "_LOOP_LOCK" \
+                and isinstance(stmt.value, ast.Constant) \
+                and isinstance(stmt.value.value, str):
+            info.loop_lock = stmt.value.value
 
     # pass 1: lock members + attribute types from __init__
     for stmt in cls_node.body:
@@ -273,6 +301,8 @@ def _check_mixed_lock(cls: _Class) -> List[Finding]:
         if m.name in ("__init__", "__del__"):
             continue  # construction/teardown are single-threaded
         for a in m.accesses:
+            if a.attr in cls.loop_owned:
+                continue  # covered by conc-loop-ownership instead
             held = a.held | m.entry_held
             st = stats.setdefault(a.attr, [False, False, False, None, set()])
             if held:
@@ -294,6 +324,78 @@ def _check_mixed_lock(cls: _Class) -> List[Finding]:
                 message=(f"attribute `{attr}` is accessed both under "
                          f"{lk} and with no lock held — the unlocked "
                          "side races with the locked writers"),
+            ))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# rule: conc-loop-ownership
+# --------------------------------------------------------------------------
+
+def _loop_roots(cls: _Class) -> Set[str]:
+    """Methods handed to a thread/loop constructor as its entrypoint:
+    ``threading.Thread(target=self._m)``, ``ServingLoop(tick=self._m)``,
+    ``ServingLoop(handler=self._m)``. (``wake=`` is excluded: wake hooks
+    run on whichever thread advances the state machine.)"""
+    roots: Set[str] = set()
+    for m in cls.methods.values():
+        for c in m.calls:
+            fn = _dotted(c.node.func) or ""
+            if fn.split(".")[-1] not in ("Thread", "ServingLoop"):
+                continue
+            for kw in c.node.keywords:
+                if kw.arg in ("target", "tick", "handler") \
+                        and isinstance(kw.value, ast.Attribute) \
+                        and isinstance(kw.value.value, ast.Name) \
+                        and kw.value.value.id == "self":
+                    roots.add(kw.value.attr)
+    return roots
+
+
+def _reach(cls: _Class, seeds: Set[str]) -> Set[str]:
+    """Transitive closure of intra-class ``self.m()`` calls."""
+    seen = {s for s in seeds if s in cls.methods}
+    frontier = list(seen)
+    while frontier:
+        name = frontier.pop()
+        for c in cls.methods[name].calls:
+            if c.self_method and c.self_method in cls.methods \
+                    and c.self_method not in seen:
+                seen.add(c.self_method)
+                frontier.append(c.self_method)
+    return seen
+
+
+def _check_loop_ownership(cls: _Class) -> List[Finding]:
+    """Writes to declared loop-owned attrs are legal (a) on the loop
+    thread itself — methods reachable ONLY from the loop entrypoints —
+    or (b) anywhere else under the declared loop lock. Anything else is
+    exactly the race the mixed-lock exemption would otherwise hide."""
+    if not cls.loop_owned or cls.loop_lock is None:
+        return []
+    public = {n for n in cls.methods if not n.startswith("_")}
+    exclusive = _reach(cls, _loop_roots(cls)) - _reach(cls, public)
+    findings: List[Finding] = []
+    seen: Set[str] = set()
+    for m in cls.methods.values():
+        if m.name in ("__init__", "__del__") or m.name in exclusive:
+            continue
+        for a in m.accesses:
+            if not a.write or a.attr not in cls.loop_owned:
+                continue
+            if cls.loop_lock in (a.held | m.entry_held):
+                continue
+            detail = f"{m.name}:{a.attr}"
+            if detail in seen:
+                continue
+            seen.add(detail)
+            findings.append(Finding(
+                rule="conc-loop-ownership", path=cls.path, line=a.line,
+                col=0, scope=f"{cls.name}.{m.name}", detail=detail,
+                message=(f"loop-owned attribute `{a.attr}` written off "
+                         f"the owning loop thread without `self."
+                         f"{cls.loop_lock}` — the loop reads it "
+                         "lock-free between rounds, so this write races"),
             ))
     return findings
 
@@ -468,6 +570,7 @@ def check_module(tree: ast.Module, relpath: str) -> List[Finding]:
     findings: List[Finding] = []
     for cls in _classes_of(tree, relpath):
         findings.extend(_check_mixed_lock(cls))
+        findings.extend(_check_loop_ownership(cls))
         findings.extend(_check_blocking(cls))
     findings.extend(_check_monotonic(tree, relpath))
     return findings

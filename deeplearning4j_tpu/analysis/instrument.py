@@ -19,12 +19,27 @@ rank  lock
 ====  =====================================
 10    StreamingBroker._lock
 20    ParallelInference._lock
+25    ServingLoop._cond
 30    ParallelInference._drain_cv, GenerationServer._cond
+35    ReplicaFleet._cond
 40    KerasBackendServer._lock
+55    LoopSupervisor._lock
 60    AdmissionController._lock
 70    CircuitBreaker._lock
 80    RetryPolicy._lock
 ====  =====================================
+
+The serving runtime slots in at 25: servers may touch their ServingLoop
+(``begin_drain``/``close``/``put``) while holding a sub-25 lock, but the
+re-homed servers always call the runtime with NO server lock held — the
+runtime in turn invokes its callbacks (tick/handler/wake/on_death)
+outside ``_cond``, so wake hooks may notify server conditions (rank
+30/35) freely. ``ReplicaFleet._cond`` ranks above the replica servers'
+locks because replica completion callbacks run under a server lock and
+then take the fleet's. ``LoopSupervisor._lock`` ranks above every loop
+and server lock it can be entered under (watch() from a locked
+_ensure_workers); the supervisor copies its watch table under ``_lock``
+and recovers loops outside it, so it never acquires downward.
 
 (Serving stats counters moved into the per-metric leaf locks of the
 metrics registry — metrics/registry.py — which rank strictly last:
@@ -147,18 +162,24 @@ class OrderedCondition(OrderedLock):
 #: class -> {attr: (rank, is_condition)}
 def _targets() -> Dict[type, Dict[str, Tuple[int, bool]]]:
     from deeplearning4j_tpu.modelimport.server import KerasBackendServer
+    from deeplearning4j_tpu.parallel.fleet import ReplicaFleet
     from deeplearning4j_tpu.parallel.generation import GenerationServer
     from deeplearning4j_tpu.parallel.inference import ParallelInference
     from deeplearning4j_tpu.parallel.resilience import (AdmissionController,
                                                         CircuitBreaker,
                                                         RetryPolicy)
+    from deeplearning4j_tpu.parallel.runtime import (LoopSupervisor,
+                                                     ServingLoop)
     from deeplearning4j_tpu.streaming.broker import StreamingBroker
 
     return {
         StreamingBroker: {"_lock": (10, False)},
         ParallelInference: {"_lock": (20, False), "_drain_cv": (30, True)},
+        ServingLoop: {"_cond": (25, True)},
         GenerationServer: {"_cond": (30, True)},
+        ReplicaFleet: {"_cond": (35, True)},
         KerasBackendServer: {"_lock": (40, False)},
+        LoopSupervisor: {"_lock": (55, False)},
         AdmissionController: {"_lock": (60, False)},
         CircuitBreaker: {"_lock": (70, False)},
         RetryPolicy: {"_lock": (80, False)},

@@ -245,11 +245,17 @@ class KerasBackendServer:
                 # the model-lock wait can eat the whole budget under load
                 self._check_deadline(deadline, "waiting for the model lock")
                 net = self._net(mid)
-                x = np.asarray(features, np.float32)
-                dispatch = (self._chaos.wrap(net.output)
-                            if self._chaos is not None else net.output)
+            x = np.asarray(features, np.float32)
+            dispatch = (self._chaos.wrap(net.output)
+                        if self._chaos is not None else net.output)
 
-                def attempt():
+            def attempt():
+                # each ATTEMPT serializes under the model lock, but the
+                # retry backoff sleeps happen outside it: one request's
+                # retry storm must not stall every other HTTP worker
+                with self._lock:
+                    self._check_deadline(deadline,
+                                         "waiting for the model lock")
                     try:
                         result = dispatch(x)
                     except Exception:
@@ -258,8 +264,8 @@ class KerasBackendServer:
                     self.breaker.record_success()
                     return result
 
-                out = self.retry.call(attempt, deadline=deadline,
-                                      on_retry=self._count_retry)
+            out = self.retry.call(attempt, deadline=deadline,
+                                  on_retry=self._count_retry)
             self._m_completed.inc()
         except Exception:
             self._m_failed.inc()

@@ -79,6 +79,8 @@ from deeplearning4j_tpu.parallel.resilience import (
     AdmissionController, CircuitBreaker, CircuitOpen, Deadline,
     DeadlineExceeded, ReplicaKilled, ReplicaUnavailable, ResilienceError,
     ServerOverloaded)
+from deeplearning4j_tpu.parallel.runtime import (LoopCrashed, LoopState,
+                                                 ServingLoop, supervisor)
 
 log = logging.getLogger(__name__)
 
@@ -201,7 +203,8 @@ class ReplicaFleet:
                  = None,
                  health_alpha: float = 0.25, tick_s: float = 0.005,
                  registry: Optional[MetricsRegistry] = None,
-                 roles: Optional[Sequence[str]] = None):
+                 roles: Optional[Sequence[str]] = None,
+                 chaos: Any = None):
         if int(replicas) < 1:
             raise ValueError("need at least one replica")
         if roles is not None:
@@ -242,8 +245,10 @@ class ReplicaFleet:
         self._pending: deque = deque()   # parked _FleetRequests (redispatch)
         self._inflight_reqs: set = set()  # every unresolved _FleetRequest
         self._replicas: List[_Replica] = []
-        self._closing = False
-        self._stop = False
+        # distinguishes a deliberate close() from a monitor crash: the
+        # supervisor only restarts the monitor loop when this is False
+        self._user_close = False
+        self._chaos = chaos
         self._degraded = False  # decode tier dark -> co-located serving
         # fleet-wide aggregates live in the (leaf-locked) registry: the
         # routing path and completion callbacks publish without holding
@@ -321,9 +326,22 @@ class ReplicaFleet:
             self._replicas.append(self._new_replica(rid, 0, server))
         self._tiered = any(r.role != "unified" for r in self._replicas)
 
-        self._monitor = threading.Thread(target=self._monitor_loop,
-                                         name="fleet-monitor", daemon=True)
-        self._monitor.start()
+        self._runtime = ServingLoop("fleet-monitor",
+                                    tick=self._monitor_tick,
+                                    wake=self._wake_monitor, chaos=chaos)
+        self._runtime.start()
+        supervisor().watch(self._runtime, on_death=self._on_monitor_death,
+                           restart=True)
+
+    # -- lifecycle state -----------------------------------------------
+    @property
+    def _closing(self) -> bool:
+        """True once the lifecycle left RUNNING (draining or closed)."""
+        return self._runtime.state in (LoopState.DRAINING, LoopState.CLOSED)
+
+    @property
+    def _stop(self) -> bool:
+        return self._runtime.state is LoopState.CLOSED
 
     # -- construction helpers ------------------------------------------
 
@@ -480,18 +498,17 @@ class ReplicaFleet:
         seconds to finish (re-dispatch keeps running), then stop the
         monitor, close every replica, and fail any stragglers typed.
         Idempotent."""
+        already = self._stop
         with self._cond:
-            already = self._stop
-            self._closing = True
-            self._cond.notify_all()
+            # before the drain begins, so a chaos kill landing mid-drain
+            # cannot win a restart race against this deliberate close
+            self._user_close = True
+        self._runtime.begin_drain()   # submit() now rejects typed
         if not already:
             self.drain(timeout)
+        self._runtime.close(5.0)
         with self._cond:
-            self._stop = True
-            self._cond.notify_all()
             reps = list(self._replicas)
-        if self._monitor.is_alive():
-            self._monitor.join(timeout=5.0)
         for rep in reps:
             try:
                 rep.server.close(timeout=1.0)
@@ -1005,49 +1022,74 @@ class ReplicaFleet:
 
     # -- monitor: redispatch, hedging, supervised restart --------------
 
-    def _monitor_loop(self) -> None:
-        while True:
-            with self._cond:
-                if self._stop:
-                    return
-                self._cond.wait(timeout=self._tick_s)
-                if self._stop:
-                    return
-                now = time.monotonic()
-                work = []
-                while self._pending:
-                    work.append(self._pending.popleft())
-                spawn = []
-                if self._restart:
-                    for r in self._replicas:
-                        if r.state == DEAD and r.restart_at <= now:
-                            r.state = SPAWNING
-                            spawn.append(r.rid)
-                if self._tiered and self._degraded and any(
-                        r.state == READY
-                        and r.role in ("decode", "unified")
-                        for r in self._replicas):
-                    # a decode-capable replica healed: leave degraded
-                    # mode; new work flows through the tier pipeline
-                    self._note_degraded(False)
-                hedges = []
-                if self._hedge_after_s is not None:
-                    for freq in self._inflight_reqs:
-                        if (not freq.resolved
-                                and len(freq.active) == 1
-                                and freq.hedges < self._max_hedges
-                                and now - freq.t_dispatch
-                                >= self._hedge_after_s):
-                            hedges.append(freq)
-            for rid in spawn:
-                self._respawn(rid)
-            for freq in work:
-                self._service_parked(freq)
-            for freq in hedges:
-                try:
-                    self._route_once(freq, hedge=True)
-                except ValueError:
-                    pass  # original attempt is still running; let it win
+    def _wake_monitor(self) -> None:
+        """Runtime wake hook: nudge a tick blocked on ``_cond``."""
+        with self._cond:
+            self._cond.notify_all()
+
+    def _monitor_tick(self) -> bool:
+        """One monitor round, hosted by the ``ServingLoop`` tick thread
+        ("fleet-monitor"). Returns False only on a clean stop."""
+        with self._cond:
+            if self._stop:
+                return False
+            self._cond.wait(timeout=self._tick_s)
+            if self._stop:
+                return False
+            now = time.monotonic()
+            work = []
+            while self._pending:
+                work.append(self._pending.popleft())
+            spawn = []
+            if self._restart:
+                for r in self._replicas:
+                    if r.state == DEAD and r.restart_at <= now:
+                        r.state = SPAWNING
+                        spawn.append(r.rid)
+            if self._tiered and self._degraded and any(
+                    r.state == READY
+                    and r.role in ("decode", "unified")
+                    for r in self._replicas):
+                # a decode-capable replica healed: leave degraded
+                # mode; new work flows through the tier pipeline
+                self._note_degraded(False)
+            hedges = []
+            if self._hedge_after_s is not None:
+                for freq in self._inflight_reqs:
+                    if (not freq.resolved
+                            and len(freq.active) == 1
+                            and freq.hedges < self._max_hedges
+                            and now - freq.t_dispatch
+                            >= self._hedge_after_s):
+                        hedges.append(freq)
+        for rid in spawn:
+            self._respawn(rid)
+        for freq in work:
+            self._service_parked(freq)
+        for freq in hedges:
+            try:
+                self._route_once(freq, hedge=True)
+            except ValueError:
+                pass  # original attempt is still running; let it win
+        return True
+
+    def _on_monitor_death(self, loop, exc) -> bool:
+        """Supervisor recovery hook: the monitor thread died. Parked and
+        in-flight requests are untouched when the monitor restarts (the
+        replicas keep serving; redispatch resumes on the fresh thread) —
+        but on a deliberately closing fleet the parked queue is failed
+        typed so nothing hangs."""
+        with self._cond:
+            again = not self._user_close
+            parked = [] if again else list(self._pending)
+            if not again:
+                self._pending.clear()
+            self._cond.notify_all()
+        err = LoopCrashed(f"fleet-monitor died with the request parked: "
+                          f"{exc!r}")
+        for freq in parked:
+            self._resolve(freq, None, err)
+        return again
 
     def _service_parked(self, freq: _FleetRequest) -> None:
         try:

@@ -77,6 +77,9 @@ from deeplearning4j_tpu.parallel.resilience import (AdmissionController,
                                                     DeadlineExceeded,
                                                     RetryPolicy,
                                                     ServerOverloaded)
+from deeplearning4j_tpu.parallel.runtime import (CLOSED, DRAINING,
+                                                 LoopCrashed, ServingLoop,
+                                                 supervisor)
 
 _UNSET = object()
 
@@ -223,6 +226,12 @@ class GenerationServer:
     with the non-speculative paths by construction.
     """
 
+    # Decode-loop-owned state (conc-loop-ownership, see
+    # analysis/concurrency_rules.py): every write happens under ``_cond``
+    # but the tick thread reads it lock-free between dispatches.
+    _LOOP_OWNED = ("_slot_req",)
+    _LOOP_LOCK = "_cond"
+
     def __init__(self, net, vocab: int, *, slots: int = 8,
                  eos_id: Optional[int] = None,
                  max_pending: int = 64,
@@ -337,8 +346,9 @@ class GenerationServer:
         self._slot_req: list = [None] * self.slots
         self._n_active = 0
         self._active_cap = self.slots
-        self._closing = False
-        self._stop = False
+        # distinguishes a deliberate close() from a crash-forced CLOSED
+        # state: the supervisor only restarts the loop when this is False
+        self._user_close = False
 
         # host mirrors of the per-slot decode state fed to the step
         self._last = np.zeros(self.slots, np.int32)
@@ -483,9 +493,22 @@ class GenerationServer:
 
         self._pool = self._fresh_pool()
         self._dpool = None if draft_net is None else self._fresh_draft_pool()
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="generation-server")
-        self._thread.start()
+        self._runtime = ServingLoop("generation-server",
+                                    tick=self._tick_once,
+                                    wake=self._wake_loop, chaos=chaos)
+        self._runtime.start()
+        supervisor().watch(self._runtime, on_death=self._on_loop_death,
+                           restart=True)
+
+    # ------------------------------------------------- lifecycle state
+    @property
+    def _closing(self) -> bool:
+        """True once the lifecycle left RUNNING (draining or closed)."""
+        return self._runtime.state in (DRAINING, CLOSED)
+
+    @property
+    def _stop(self) -> bool:
+        return self._runtime.state is CLOSED
 
     def _breaker_level(self) -> float:
         if self.breaker is None:
@@ -1119,38 +1142,92 @@ class GenerationServer:
         return req.future
 
     # ---------------------------------------------------------- the loop
-    def _loop(self):
-        while True:
+    def _wake_loop(self):
+        """Runtime wake hook: nudge a tick blocked on ``_cond``."""
+        with self._cond:
+            self._cond.notify_all()
+
+    def _tick_once(self) -> bool:
+        """One scheduling round of the decode loop, hosted by the
+        ``ServingLoop`` tick thread ("generation-server"). Returns False
+        only on a clean stop (loop CLOSED)."""
+        with self._cond:
+            if self._stop:
+                return False
+            migrating = self._migrating
+            if (not self._queue and self._n_active == 0
+                    and not self._export_q and not migrating):
+                self._cond.wait(timeout=0.5)
+                return True
+        try:
+            if migrating:
+                if self._chaos is not None:
+                    # a migrate-out sweep IS a drain phase: shutdown-phase
+                    # chaos (kill_during_drain) attacks it too, and the
+                    # LoopKilled it raises is a BaseException precisely so
+                    # it escapes the except below into the supervisor
+                    fault = getattr(self._chaos, "drain_fault", None)
+                    if fault is not None:
+                        fault()
+                self._migrate_out()
+            self._admit_free_slots()
             with self._cond:
-                if self._stop:
-                    return
-                migrating = self._migrating
-                if (not self._queue and self._n_active == 0
-                        and not self._export_q and not migrating):
-                    self._cond.wait(timeout=0.5)
-                    continue
-            try:
-                if migrating:
-                    self._migrate_out()
-                self._admit_free_slots()
-                with self._cond:
-                    n_active = self._n_active
-                if n_active:
-                    t0 = time.monotonic()
-                    if self._draft is not None:
-                        self._spec_decode_once()
-                    else:
-                        self._decode_once()
-                    self._m_busy_s.inc(time.monotonic() - t0)
-                self._expire_active()
-                # handoff housekeeping rides BETWEEN dispatches: explicit
-                # exports first (a caller is blocked on them), then at
-                # most one periodic low-priority snapshot per iteration
-                self._service_exports()
-                self._maybe_snapshot_slots()
-            except Exception as e:  # noqa: BLE001 — a loop death would
-                # hang every outstanding future; fail them typed instead
-                self._fail_all(e)
+                n_active = self._n_active
+            if n_active:
+                t0 = time.monotonic()
+                if self._draft is not None:
+                    self._spec_decode_once()
+                else:
+                    self._decode_once()
+                self._m_busy_s.inc(time.monotonic() - t0)
+            self._expire_active()
+            # handoff housekeeping rides BETWEEN dispatches: explicit
+            # exports first (a caller is blocked on them), then at
+            # most one periodic low-priority snapshot per iteration
+            self._service_exports()
+            self._maybe_snapshot_slots()
+        except Exception as e:  # noqa: BLE001 — a loop death would
+            # hang every outstanding future; fail them typed instead
+            self._fail_all(e)
+        return True
+
+    def _on_loop_death(self, loop, exc) -> bool:
+        """Supervisor recovery hook: the decode tick thread died (a chaos
+        kill or an untrappable fault that escaped ``_fail_all``). Fail
+        every in-flight future and pending export typed, release the dead
+        slots' pages, and — unless the server was deliberately closed —
+        rebuild device state so the supervised restart serves cleanly."""
+        err = LoopCrashed("generation-server loop died with the request "
+                          f"in flight: {exc!r}")
+        with self._cond:
+            stragglers = [s for s in range(self.slots)
+                          if self._slot_req[s] is not None]
+            victims = [self._slot_req[s] for s in stragglers]
+            victims += list(self._queue)
+            self._queue.clear()
+            self._slot_req = [None] * self.slots
+            self._n_active = 0
+            exports = list(self._export_q)
+            self._export_q.clear()
+            # a kill mid-migration resolved every live future (below), so
+            # the migration is over — a latched flag would make the
+            # restarted tick re-enter the drain path forever
+            self._migrating = False
+            self._migrate_cb = None
+            again = not self._user_close
+            self._cond.notify_all()
+        self._m_failed.inc(len(victims))
+        for req in victims:
+            self._fail(req, err)
+        for _fut, out in exports:  # never leave an exporter hung
+            self._fail_export(out, SnapshotUnavailable(
+                "generation loop died before the export was serviced"))
+        for s in stragglers:  # tick thread is dead: safe to touch pages
+            self._release_slot_pages(s)
+        if again:
+            self._m_pool_rebuilds.inc()
+            self._reset_device_state()
+        return again
 
     def _pop_admittable(self):
         """Next queued request still worth prefilling (expired ones fail
@@ -2224,17 +2301,15 @@ class GenerationServer:
         """Stop admitting, drain what is in flight, stop the loop. Any
         request still unresolved past ``timeout`` fails typed — a closed
         server never leaves a hung future behind (and never leaks its
-        pages)."""
+        pages). Idempotent and re-entrant: safe from any thread, twice,
+        or concurrently — the runtime serializes the actual shutdown."""
         with self._cond:
-            if self._closing and self._stop:
-                return
-            self._closing = True
-            self._cond.notify_all()
+            # before the drain begins, so a chaos kill landing mid-drain
+            # cannot win a restart race against this deliberate close
+            self._user_close = True
+        self._runtime.begin_drain()   # submit() now rejects typed
         self.drain(timeout)
-        with self._cond:
-            self._stop = True
-            self._cond.notify_all()
-        self._thread.join(timeout=max(timeout, 1.0))
+        self._runtime.close(max(timeout, 1.0))
         with self._cond:
             stragglers = [s for s in range(self.slots)
                           if self._slot_req[s] is not None]

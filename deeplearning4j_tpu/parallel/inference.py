@@ -58,9 +58,8 @@ from deeplearning4j_tpu.parallel.resilience import (AdmissionController,
                                                     CircuitOpen, Deadline,
                                                     DeadlineExceeded,
                                                     RetryPolicy)
-
-_SHUTDOWN = object()
-_RESIGN = object()  # scale-down token: one coalescer exits, queue stays up
+from deeplearning4j_tpu.parallel.runtime import (LoopClosed, LoopCrashed,
+                                                 ServingLoop, supervisor)
 
 
 class _Request:
@@ -172,15 +171,21 @@ class ParallelInference:
                            fn=lambda: self.coalescer_workers)
         self._drain_cv = threading.Condition()
         self._draining = False
-        self._submit_q: Optional[queue.Queue] = None
-        self._inflight_q: Optional[queue.Queue] = None
-        self._threads: list = []
+        self._chaos = chaos
+        # both worker stacks are hosted on the shared serving runtime
+        # (parallel/runtime.py): the coalescer pool and the completer are
+        # each one supervised ServingLoop with the uniform
+        # NEW/RUNNING/DRAINING/CLOSED lifecycle
+        self._coalescer: Optional[ServingLoop] = None
+        self._completer: Optional[ServingLoop] = None
+        # futures admitted but not yet resolved: the supervisor's
+        # on_death contract fails every one of these typed when a loop
+        # thread dies, so a crash mid-batch cannot strand a caller
+        self._outstanding: set = set()
         self._lock = threading.Lock()
         self._closed = False
         self._coalescer_target = min(self.max_coalescers,
                                      max(1, int(coalescers)))
-        self._live_coalescers = 0
-        self._coalescer_seq = 0
 
     def _breaker_level(self) -> float:
         if self.breaker is None:
@@ -280,7 +285,7 @@ class ParallelInference:
                 raise RuntimeError("ParallelInference is closed"
                                    if self._closed else
                                    "ParallelInference is draining")
-            submit_q = self._ensure_workers()
+            co = self._ensure_workers()
         if self.breaker is not None and not self.breaker.allow():
             self._m_rejected_circuit.inc()
             raise CircuitOpen("circuit breaker is open: recent dispatches "
@@ -297,20 +302,34 @@ class ParallelInference:
         # leak no matter which thread resolves the future
         req.future.add_done_callback(
             lambda f, t0=req.t0: self._on_done(f, t0))
-        submit_q.put(req)
+        with self._lock:
+            self._outstanding.add(req.future)
+        try:
+            co.put(req)
+        except LoopClosed:
+            # close() (or a loop crash) raced this submit past the checks
+            # above: fail the future rather than hang the caller. _fail
+            # tolerates the other side of the race having resolved it.
+            with self._lock:
+                closed = self._closed
+            self._fail(req.future,
+                       RuntimeError("ParallelInference is closed") if closed
+                       else LoopCrashed("pi-coalescer is restarting; "
+                                        "resubmit the request"))
+            return req.future
         with self._lock:
             closed = self._closed
         if closed and not req.future.done():
-            # close() raced this submit past the _closed check above: the
-            # request may sit behind the shutdown sentinel (or behind
-            # close()'s queue drain) where no thread will ever serve it —
-            # fail it rather than hang the caller. _fail tolerates the
-            # other side of the race having resolved it first.
+            # the put itself raced close() in: the runtime's leftover
+            # drain (re-run by put()) normally fails it, but cover the
+            # window where the drain ran before our enqueue landed
             self._fail(req.future,
                        RuntimeError("ParallelInference is closed"))
         return req.future
 
     def _on_done(self, fut: Future, t0: Optional[float] = None) -> None:
+        with self._lock:
+            self._outstanding.discard(fut)
         self.admission.release()
         if fut.exception() is None:
             self._m_completed.inc()
@@ -349,36 +368,57 @@ class ParallelInference:
         except Exception:  # noqa: BLE001 — already resolved, either way
             pass
 
-    def _ensure_workers(self) -> queue.Queue:
-        """Start the coalescer(s)/completer once and return the submit
-        queue. Caller must hold ``self._lock``; the worker loops receive
-        their queues as arguments so they never re-read the attributes
-        outside it."""
-        if not self._threads:
-            self._submit_q = queue.Queue()
-            # bounded: backpressures the coalescers when `inflight` batches
-            # are dispatched but not yet fetched
-            self._inflight_q = queue.Queue(maxsize=self.inflight)
-            completer = threading.Thread(
-                target=self._complete_loop, args=(self._inflight_q,),
-                name="pi-completer", daemon=True)
-            self._threads = [completer]
+    def _ensure_workers(self) -> ServingLoop:
+        """Start the runtime loops once and return the coalescer loop.
+        Caller must hold ``self._lock``. Both loop refs are published to
+        the instance BEFORE any request can reach a worker (the first
+        put happens after this returns), so ``_coalesce_entry`` can
+        snapshot them safely."""
+        if self._coalescer is None:
+            # bounded completer inbox: backpressures the coalescers when
+            # `inflight` batches are dispatched but not yet fetched
+            completer = ServingLoop(
+                "pi-completer", handler=self._complete_loop,
+                inbox_maxsize=self.inflight,
+                on_leftover=self._fail_inflight_leftover,
+                chaos=self._chaos)
+            coalescer = ServingLoop(
+                "pi-coalescer", handler=self._coalesce_entry,
+                workers=self._coalescer_target,
+                max_workers=self.max_coalescers,
+                on_leftover=self._fail_submit_leftover,
+                chaos=self._chaos)
+            self._completer = completer
+            self._coalescer = coalescer
             completer.start()
-            for _ in range(self._coalescer_target):
-                self._spawn_coalescer_locked()
-        return self._submit_q
+            coalescer.start()
+            sup = supervisor()
+            sup.watch(completer, on_death=self._on_loop_death, restart=True)
+            sup.watch(coalescer, on_death=self._on_loop_death, restart=True)
+        return self._coalescer
 
-    def _spawn_coalescer_locked(self) -> None:
-        """Start one coalescer thread on the shared queues. Caller must
-        hold ``self._lock``."""
-        self._coalescer_seq += 1
-        t = threading.Thread(
-            target=self._coalesce_loop,
-            args=(self._submit_q, self._inflight_q),
-            name=f"pi-coalescer-{self._coalescer_seq}", daemon=True)
-        self._live_coalescers += 1
-        self._threads.append(t)
-        t.start()
+    def _on_loop_death(self, loop: ServingLoop, exc: BaseException):
+        """Uniform recovery contract (LoopSupervisor): every admitted but
+        unresolved future fails typed — a dead loop thread never strands
+        a caller — and the supervised restart proceeds unless the server
+        is deliberately closing."""
+        with self._lock:
+            victims = list(self._outstanding)
+            closed = self._closed
+        err = LoopCrashed(f"{loop.name} died with the request in flight: "
+                          f"{exc!r}")
+        for f in victims:
+            if not f.done():
+                self._fail(f, err)
+        return not closed
+
+    def _fail_submit_leftover(self, req) -> None:
+        self._fail(req.future, RuntimeError("ParallelInference is closed"))
+
+    def _fail_inflight_leftover(self, item) -> None:
+        _out, batch = item
+        for r in batch:
+            self._fail(r.future, RuntimeError("ParallelInference is closed"))
 
     @property
     def coalescer_workers(self) -> int:
@@ -389,27 +429,18 @@ class ParallelInference:
     def set_coalescer_workers(self, n: int) -> int:
         """Scale the coalescer pool to ``n`` threads (clamped to
         [1, max_coalescers]). Scale-up spawns threads on the shared
-        submit queue; scale-down enqueues resign tokens, so a coalescer
-        finishes its current batch and exits cleanly. The target never
-        drops below 1, so the shutdown sentinel always finds a live
-        coalescer to propagate through."""
+        inbox; scale-down retires workers via the runtime's resign
+        tokens, so a coalescer finishes its current batch and exits
+        cleanly. The target never drops below 1, so the shutdown
+        sentinel always finds a live coalescer to propagate through."""
         n = min(self.max_coalescers, max(1, int(n)))
-        resigns = 0
         with self._lock:
             if self._closed:
                 return self._coalescer_target
-            delta = n - self._coalescer_target
             self._coalescer_target = n
-            if not self._threads:
-                return n  # not started yet: _ensure_workers spawns n
-            if delta > 0:
-                for _ in range(delta):
-                    self._spawn_coalescer_locked()
-            elif delta < 0:
-                resigns = -delta
-            submit_q = self._submit_q
-        for _ in range(resigns):
-            submit_q.put(_RESIGN)
+            co = self._coalescer
+        if co is not None:
+            co.set_workers(n)
         return n
 
     def _expire_if_dead(self, req) -> bool:
@@ -432,69 +463,61 @@ class ParallelInference:
         still lands BEFORE expiry instead of exactly on it."""
         return d.expires_at - 0.25 * max(0.0, d.remaining())
 
-    def _coalesce_loop(self, q: queue.Queue, inflight_q: queue.Queue):
+    def _coalesce_entry(self, first):
+        """Coalescer loop handler. Snapshots the loop refs under the
+        lock, then assembles and dispatches entirely outside it — the
+        retry backoff and queue waits in the batch path must never run
+        under ``self._lock``."""
+        with self._lock:
+            co, completer = self._coalescer, self._completer
+        return self._coalesce_once(first, co, completer)
+
+    def _coalesce_once(self, first, co: ServingLoop, completer: ServingLoop):
+        """Coalescer handler: assemble ONE batch starting from ``first``
+        and dispatch it. Returns the mismatched request that forced an
+        early flush (the runtime hands it back as this worker's next
+        head), or None. Sentinel/resign/pool-walk choreography lives in
+        the runtime, not here."""
+        if self._expire_if_dead(first):
+            return None
         head = None
-        while True:
-            first = head if head is not None else q.get()
-            head = None
-            if first is _RESIGN:
-                # scale-down token: this coalescer exits, the rest live on.
-                # If a racing close() left this as the last coalescer (the
-                # resign overtook the sentinel chain), forward the shutdown
-                # so the completer still stops; close() drains the now-
-                # ownerless sentinel from the submit queue.
-                with self._lock:
-                    self._live_coalescers -= 1
-                    last = self._live_coalescers <= 0 and self._closed
-                if last:
-                    inflight_q.put(_SHUTDOWN)
-                return
-            if first is _SHUTDOWN:
-                # the sentinel walks the whole pool: each coalescer passes
-                # it on, the LAST one forwards it to the completer
-                with self._lock:
-                    self._live_coalescers -= 1
-                    last = self._live_coalescers <= 0
-                if last:
-                    inflight_q.put(_SHUTDOWN)
-                else:
-                    q.put(_SHUTDOWN)
-                return
-            if self._expire_if_dead(first):
+        batch = [first]
+        rows = first.n
+        sig = first.signature()
+        deadline = time.monotonic() + self.max_wait_s
+        if first.deadline is not None:
+            # remaining-time propagation: a member with less budget
+            # than the coalesce window flushes the batch early, so it
+            # is dispatched before it expires rather than after
+            deadline = min(deadline, self._flush_by(first.deadline))
+        while rows < self.max_batch:
+            wait = deadline - time.monotonic()
+            if wait <= 0:
+                break
+            try:
+                # loop.get never hands out control tokens: a shutdown
+                # sentinel arriving mid-assembly re-queues and raises
+                # Empty, so the batch flushes and the main consume loop
+                # runs the pool walk
+                nxt = co.get(timeout=wait)
+            except queue.Empty:
+                break
+            if nxt.signature() != sig:
+                head = nxt  # flush now; the mismatch starts its own batch
+                break
+            if self._expire_if_dead(nxt):
                 continue
-            batch = [first]
-            rows = first.n
-            sig = first.signature()
-            deadline = time.monotonic() + self.max_wait_s
-            if first.deadline is not None:
-                # remaining-time propagation: a member with less budget
-                # than the coalesce window flushes the batch early, so it
-                # is dispatched before it expires rather than after
-                deadline = min(deadline, self._flush_by(first.deadline))
-            while rows < self.max_batch:
-                wait = deadline - time.monotonic()
-                if wait <= 0:
-                    break
-                try:
-                    nxt = q.get(timeout=wait)
-                except queue.Empty:
-                    break
-                if nxt is _SHUTDOWN or nxt is _RESIGN \
-                        or nxt.signature() != sig:
-                    head = nxt  # flush now; the mismatch starts its own batch
-                    break
-                if self._expire_if_dead(nxt):
-                    continue
-                batch.append(nxt)
-                rows += nxt.n
-                if nxt.deadline is not None:
-                    deadline = min(deadline, self._flush_by(nxt.deadline))
-            self._dispatch_batch(batch, inflight_q)
+            batch.append(nxt)
+            rows += nxt.n
+            if nxt.deadline is not None:
+                deadline = min(deadline, self._flush_by(nxt.deadline))
+        self._dispatch_batch(batch, completer)
+        return head
 
     def _count_retry(self, attempt, exc) -> None:
         self._m_retried.inc()
 
-    def _dispatch_batch(self, batch, inflight_q: queue.Queue):
+    def _dispatch_batch(self, batch, completer: ServingLoop):
         # last expiry gate: members that died waiting in the assembly
         # window fail typed here, before any padding or device work
         batch = [r for r in batch if not self._expire_if_dead(r)]
@@ -531,29 +554,48 @@ class ParallelInference:
                 if not self._expire_if_dead(r):
                     self._fail(r.future, e)
             return
-        # blocks when `inflight` batches are already pending — bounded
-        # pipeline: device compute overlaps the NEXT batch's host assembly
-        inflight_q.put((out, batch))
-
-    def _complete_loop(self, inflight_q: queue.Queue):
+        # bounded pipeline: blocks when `inflight` batches are already
+        # pending, so device compute overlaps the NEXT batch's host
+        # assembly. The put is chunked so a dead completer cannot wedge
+        # this coalescer forever: each timeout re-checks the completer's
+        # health and fails the batch typed instead of stranding it.
         while True:
-            item = inflight_q.get()
-            if item is _SHUTDOWN:
-                return
-            out, batch = item
-            try:
-                arr = np.asarray(out)  # the device fetch for this batch
-            except Exception as e:  # noqa: BLE001
+            if completer.crashed is not None:
+                err = LoopCrashed("pi-completer died with the batch in "
+                                  "flight")
                 for r in batch:
-                    self._fail(r.future, e)
+                    self._fail(r.future, err)
+                return
+            try:
+                completer.put((out, batch), timeout=0.2)
+                return
+            except queue.Full:
                 continue
-            ofs = 0
+            except LoopClosed:
+                err = RuntimeError("ParallelInference is closed")
+                for r in batch:
+                    self._fail(r.future, err)
+                return
+
+    def _complete_loop(self, item):
+        """Completer handler: THE single device fetch per coalesced
+        batch, sliced back per caller. Hosted on its own ServingLoop so
+        the fetch overlaps the coalescers' next assembly."""
+        out, batch = item
+        try:
+            arr = np.asarray(out)  # the device fetch for this batch
+        except Exception as e:  # noqa: BLE001
             for r in batch:
-                try:
-                    r.future.set_result(arr[ofs:ofs + r.n])
-                except Exception:  # noqa: BLE001 — lost a shutdown race
-                    pass
-                ofs += r.n
+                self._fail(r.future, e)
+            return None
+        ofs = 0
+        for r in batch:
+            try:
+                r.future.set_result(arr[ofs:ofs + r.n])
+            except Exception:  # noqa: BLE001 — lost a shutdown race
+                pass
+            ofs += r.n
+        return None
 
     # ------------------------------------------------------------ lifecycle
     def drain(self, timeout: Optional[float] = None) -> bool:
@@ -565,55 +607,65 @@ class ParallelInference:
         (drain, swap weights/process, resume)."""
         with self._lock:
             self._draining = True
-            threads = list(self._threads)
+            co, cm = self._coalescer, self._completer
+        # advance the runtime state machines too, so shutdown-phase chaos
+        # (kill_during_drain) fires on work handled from here on
+        if co is not None:
+            co.begin_drain()
+        if cm is not None:
+            cm.begin_drain()
         limit = None if timeout is None else time.monotonic() + timeout
-        with self._drain_cv:
-            while self.admission.pending > 0:
-                if not any(t.is_alive() for t in threads):
-                    # no worker will ever resolve the remainder (crashed
-                    # coalescer, or staged shutdown tests): close()'s
-                    # behind-sentinel queue drain owns those requests
+        while True:
+            # liveness read OUTSIDE _drain_cv: ServingLoop._cond ranks
+            # below the drain condition, so it may never be acquired
+            # while the cv is held
+            dead = co is None or (co.alive_workers == 0
+                                  and (cm is None
+                                       or cm.alive_workers == 0))
+            with self._drain_cv:
+                if self.admission.pending == 0:
+                    return True
+                if dead:
+                    # no loop worker will ever resolve the remainder
+                    # (crashed loops, or staged shutdown tests): close()'s
+                    # leftover drain owns those requests
                     return False
                 wait = 0.2 if limit is None else min(
                     0.2, limit - time.monotonic())
                 if wait <= 0:
                     return False
                 self._drain_cv.wait(wait)  # chunked: re-checks liveness
-        return True
 
     def close(self, timeout: float = 30.0):
         """Drain (complete in-flight work, reject new submissions), then
-        flush and stop the coalescer threads (idempotent). Pending futures
-        complete before the threads exit; requests that raced the shutdown
-        in behind the sentinel are FAILED with RuntimeError, never left
-        unresolved."""
+        flush and stop both runtime loops. Idempotent and re-entrant
+        (any thread, twice, concurrently): the runtime's sole-closer
+        discipline makes late callers wait on the first closer's
+        completion event. Pending futures complete before the loops
+        exit; requests that raced the shutdown in behind the sentinel
+        are FAILED with RuntimeError, never left unresolved."""
         with self._lock:
-            should_drain = not self._closed and bool(self._threads)
+            should_drain = not self._closed and self._coalescer is not None
         if should_drain:
             self.drain(timeout)
         with self._lock:
-            if self._closed:
-                return
             self._closed = True
-            threads, self._threads = self._threads, []
-            submit_q = self._submit_q
-        if threads:
-            submit_q.put(_SHUTDOWN)
-            for t in threads:
-                t.join(timeout=30)
-        if submit_q is None:
+            co, cm = self._coalescer, self._completer
+        if co is None:
             return
-        # drain anything a racing submit() slipped in behind the sentinel —
-        # the coalescer exited at the sentinel, so these would otherwise
-        # hold unresolved futures forever
-        while True:
-            try:
-                req = submit_q.get_nowait()
-            except queue.Empty:
-                break
-            if req is not _SHUTDOWN:
-                self._fail(req.future,
-                           RuntimeError("ParallelInference is closed"))
+        co.close(timeout)
+        cm.close(timeout)
+        # a submit that raced close() past the runtime's own leftover
+        # drain may have re-queued behind the sentinel: run the
+        # idempotent drain once more
+        co.fail_leftovers()
+        # a stalled/killed worker can leave popped-but-unresolved
+        # requests behind (stall_sentinel chaos): fail whatever is still
+        # outstanding so no caller ever hangs on result()
+        with self._lock:
+            victims = [f for f in self._outstanding if not f.done()]
+        for f in victims:
+            self._fail(f, RuntimeError("ParallelInference is closed"))
 
     def __enter__(self):
         return self

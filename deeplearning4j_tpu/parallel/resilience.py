@@ -330,7 +330,24 @@ class ChaosPolicy:
     wrap() sequences are reproduced bit-for-bit even on servers that
     call it every loop; the new drop/truncate rates gate the draw the
     same way, so pre-existing handoff fault sequences (corrupt/stall
-    only) also stay pinned."""
+    only) also stay pinned.
+
+    Shutdown-phase fault modes (for the ``ServingLoop`` lifecycle
+    drills in ``parallel/runtime.py``; injected via ``drain_fault()``
+    from a DRAINING loop's item/tick path and ``sentinel_fault()``
+    from the sentinel/clean-exit path, never from ``wrap()``):
+
+    - ``kill_during_drain_rate``: raise ``LoopKilled`` (a
+      ``BaseException``, so server loop bodies that catch ``Exception``
+      to keep serving cannot swallow it) — the loop thread dies
+      mid-drain and the supervisor must recover every in-flight future.
+    - ``stall_sentinel_rate``/``stall_sentinel_s``: the worker freezes
+      for ``stall_sentinel_s`` while retiring on the shutdown sentinel
+      — ``close(timeout)`` must give up on the join, fail leftovers,
+      and return without stranding a future.
+
+    Both draws are gated on their own non-zero rates, so every legacy
+    seeded sequence (wrap, replica, handoff) stays pinned."""
 
     def __init__(self, seed: int = 0, transient_rate: float = 0.0,
                  hard_rate: float = 0.0, latency_s: float = 0.0,
@@ -343,6 +360,9 @@ class ChaosPolicy:
                  handoff_stall_s: float = 0.0,
                  handoff_drop_rate: float = 0.0,
                  handoff_truncate_rate: float = 0.0,
+                 kill_during_drain_rate: float = 0.0,
+                 stall_sentinel_rate: float = 0.0,
+                 stall_sentinel_s: float = 0.0,
                  sleep: Callable[[float], None] = time.sleep):
         self.transient_rate = float(transient_rate)
         self.hard_rate = float(hard_rate)
@@ -358,6 +378,9 @@ class ChaosPolicy:
         self.handoff_stall_s = float(handoff_stall_s)
         self.handoff_drop_rate = float(handoff_drop_rate)
         self.handoff_truncate_rate = float(handoff_truncate_rate)
+        self.kill_during_drain_rate = float(kill_during_drain_rate)
+        self.stall_sentinel_rate = float(stall_sentinel_rate)
+        self.stall_sentinel_s = float(stall_sentinel_s)
         self._sleep = sleep
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
@@ -371,6 +394,41 @@ class ChaosPolicy:
         self.injected_handoff_stall = 0
         self.injected_handoff_drop = 0
         self.injected_handoff_truncate = 0
+        self.injected_drain_kill = 0
+        self.injected_sentinel_stall = 0
+
+    def drain_fault(self) -> None:
+        """One seeded draw per item/tick handled while the hosting
+        ``ServingLoop`` is DRAINING (and only when the rate is non-zero,
+        so every legacy seeded sequence stays pinned). On a hit, raises
+        ``LoopKilled`` — a ``BaseException`` that escapes the server
+        loop bodies' ``except Exception`` recovery and takes the loop
+        thread down mid-drain, which is exactly the failure the
+        supervisor contract must absorb."""
+        if not self.kill_during_drain_rate:
+            return
+        with self._lock:
+            hit = self._rng.random() < self.kill_during_drain_rate
+            if hit:
+                self.injected_drain_kill += 1
+        if hit:
+            from deeplearning4j_tpu.parallel.runtime import LoopKilled
+            raise LoopKilled("chaos: loop thread killed mid-drain")
+
+    def sentinel_fault(self) -> None:
+        """One seeded draw per worker retiring on the shutdown sentinel
+        (or per tick loop exiting cleanly); gated on its own non-zero
+        rate so legacy sequences stay pinned. On a hit, the retiring
+        thread stalls for ``stall_sentinel_s`` — ``close(timeout)``
+        must not hang on the join and must still fail every leftover."""
+        if not self.stall_sentinel_rate:
+            return
+        with self._lock:
+            hit = self._rng.random() < self.stall_sentinel_rate
+            if hit:
+                self.injected_sentinel_stall += 1
+        if hit:
+            self._sleep(self.stall_sentinel_s)
 
     def handoff_fault(self) -> bool:
         """Legacy boolean form of ``handoff_fault_mode()``: returns True

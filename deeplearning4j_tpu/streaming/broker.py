@@ -81,11 +81,20 @@ def write_frame(sock: socket.socket, op: bytes, topic: str,
                  + payload)
 
 
+# imported AFTER the wire-protocol surface: pulling in the parallel
+# package re-enters this module through streaming.client (resilience
+# re-exports StreamStalled), which only needs the OP_* constants and
+# frame helpers above
+from deeplearning4j_tpu.parallel.runtime import (EXIT,  # noqa: E402
+                                                 ServingLoop, supervisor)
+
+
 class _Subscriber:
     def __init__(self, sock: socket.socket, topic: str, maxsize: int):
         self.sock = sock
         self.topic = topic
         self.q: queue.Queue = queue.Queue(maxsize=maxsize)
+        self.loop: Optional[ServingLoop] = None  # writer (set pre-register)
         self.alive = True
         self.dropped = 0            # frames this subscriber never received
         self.consecutive_drops = 0  # resets on every delivered frame
@@ -110,7 +119,8 @@ class StreamingBroker:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  subscriber_buffer: int = 16, drop_limit: int = 8,
                  publish_patience_s: Optional[float] = 0.5,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 chaos=None):
         self.host = host
         self.port = port
         self.subscriber_buffer = subscriber_buffer
@@ -119,8 +129,10 @@ class StreamingBroker:
         self._subs: dict = {}          # topic -> [_Subscriber]
         self._lock = threading.Lock()
         self._server: Optional[socket.socket] = None
+        self._accept: Optional[ServingLoop] = None
         self._threads: list = []
         self._stop = threading.Event()
+        self._chaos = chaos
         # fan-out health counters live in the registry (leaf-locked);
         # broker _lock only guards subscriber bookkeeping
         self.metrics = registry if registry is not None \
@@ -156,38 +168,81 @@ class StreamingBroker:
         self._server.bind((self.host, self.port))
         self.port = self._server.getsockname()[1]
         self._server.listen(64)
-        t = threading.Thread(target=self._accept_loop, daemon=True,
-                             name="broker-accept")
-        t.start()
-        self._track(t)
+        self._accept = ServingLoop("broker-accept", tick=self._accept_tick,
+                                   chaos=self._chaos)
+        self._accept.start()
+        self._track(self._accept.threads[-1])
+        supervisor().watch(self._accept, on_death=self._on_accept_death,
+                           restart=True)
         return self
 
     def stop(self) -> None:
+        """Stop accepting, wake every writer, close every socket. Safe to
+        call twice, concurrently, and on a never-started broker."""
         self._stop.set()
-        try:
-            self._server.close()
-        except OSError:
-            pass
+        if self._server is not None:
+            try:
+                self._server.close()  # accept() raises -> clean tick exit
+            except OSError:
+                pass
+        if self._accept is not None:
+            self._accept.close(timeout=1.0)
         with self._lock:
             subs = [s for ss in self._subs.values() for s in ss]
         for s in subs:
             s.alive = False
             try:
-                s.sock.close()
+                s.sock.close()  # a writer stuck in sendall errors out
             except OSError:
                 pass
+            if s.loop is not None:
+                # the sentinel wakes a writer blocked on an empty queue
+                # (no 0.2 s polling); timeout 0 keeps stop() non-blocking
+                s.loop.close(timeout=0)
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until every live subscriber's queue has been written out
+        (the broker holds no undelivered frames). False on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                subs = [s for ss in self._subs.values() for s in ss]
+            if all(s.q.empty() for s in subs if s.alive):
+                return True
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            time.sleep(0.01)
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Drain undelivered frames, then stop the broker and join its
+        runtime loops. Idempotent and re-entrant from any thread."""
+        self.drain(timeout)
+        with self._lock:
+            subs = [s for ss in self._subs.values() for s in ss]
+        self.stop()
+        deadline = time.monotonic() + max(0.0, timeout)
+        loops = [lp for lp in [self._accept] + [s.loop for s in subs]
+                 if lp is not None]
+        for lp in loops:
+            for t in lp.threads:
+                t.join(timeout=max(0.0, deadline - time.monotonic()))
+
+    def _on_accept_death(self, loop, exc) -> bool:
+        """Supervisor hook: restart the accept loop (same listening
+        socket) unless the broker is deliberately stopping."""
+        return not self._stop.is_set()
 
     # ------------------------------------------------------------- serving
-    def _accept_loop(self):
-        while not self._stop.is_set():
-            try:
-                conn, _ = self._server.accept()
-            except OSError:
-                return
-            t = threading.Thread(target=self._serve, args=(conn,),
-                                 daemon=True)
-            t.start()
-            self._track(t)
+    def _accept_tick(self) -> bool:
+        try:
+            conn, _ = self._server.accept()
+        except OSError:
+            return False  # listening socket closed: clean exit
+        t = threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True)
+        t.start()
+        self._track(t)
+        return True
 
     def _serve(self, conn: socket.socket):
         try:
@@ -223,36 +278,44 @@ class StreamingBroker:
         # and no subsequently published frame can be missed — and no
         # racing publish can slip a data frame ahead of the ack
         sub.q.put((OP_SUB_ACK, b""))
+        # the writer is an inbox-mode ServingLoop over the subscriber's
+        # own (external) queue, started before registration so _disconnect
+        # can never observe a subscriber without a writer loop
+        sub.loop = ServingLoop(
+            f"broker-writer-{topic}",
+            handler=lambda item, s=sub: self._write_frame(s, item),
+            inbox=sub.q,
+            on_worker_exit=lambda lp, exc, s=sub: self._writer_exit(s),
+            chaos=self._chaos)
+        sub.loop.start()
+        self._track(sub.loop.threads[-1])
         with self._lock:
             self._subs.setdefault(topic, []).append(sub)
-        t = threading.Thread(target=self._writer, args=(sub,), daemon=True)
-        t.start()
-        self._track(t)
 
-    def _writer(self, sub: _Subscriber):
+    def _write_frame(self, sub: _Subscriber, item):
+        """Writer handler: one frame out; EXIT retires the writer on
+        end-of-topic or a dead consumer socket."""
+        op, payload = item
         try:
-            while sub.alive:
-                try:
-                    op, payload = sub.q.get(timeout=0.2)
-                except queue.Empty:
-                    if self._stop.is_set():
-                        return
-                    continue
-                write_frame(sub.sock, op, sub.topic, payload)
-                if op == OP_END:
-                    return
+            write_frame(sub.sock, op, sub.topic, payload)
+        except OSError:
+            return EXIT
+        if op == OP_END:
+            return EXIT
+        return None
+
+    def _writer_exit(self, sub: _Subscriber) -> None:
+        """Writer retired (end-of-topic, eviction, broker stop, or socket
+        error): deregister the subscription and close out the socket."""
+        sub.alive = False
+        with self._lock:
+            ss = self._subs.get(sub.topic, [])
+            if sub in ss:
+                ss.remove(sub)
+        try:
+            sub.sock.close()
         except OSError:
             pass
-        finally:
-            sub.alive = False
-            with self._lock:
-                ss = self._subs.get(sub.topic, [])
-                if sub in ss:
-                    ss.remove(sub)
-            try:
-                sub.sock.close()
-            except OSError:
-                pass
 
     def _fan_out(self, op: bytes, topic: str, payload: bytes):
         with self._lock:
@@ -303,9 +366,14 @@ class StreamingBroker:
                 ss.remove(s)
         self._m_subs_disconnected.inc()
         try:
-            s.sock.close()
+            s.sock.close()  # a writer stuck in sendall errors out
         except OSError:
             pass
+        if s.loop is not None:
+            # bounded: the sentinel wakes a writer blocked on get(); a
+            # full queue is skipped (the writer exits via the socket
+            # error above) so eviction never stalls the publisher
+            s.loop.close(timeout=0)
 
     def stats(self) -> dict:
         """Fan-out health counters: live subscriber count, frames dropped

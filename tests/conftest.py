@@ -182,6 +182,12 @@ def pytest_configure(config):
         "corrupt/drop/truncate transfer fallback, decode-tier-dark "
         "degraded mode + recovery — CPU-fast; runs in tier-1, "
         "deliberately NOT in the slow set)")
+    config.addinivalue_line(
+        "markers",
+        "runtime: serving-runtime lifecycle tests (ServingLoop state "
+        "machine, LoopSupervisor crash recovery, shutdown-phase chaos, "
+        "idempotent drain/close across all servers — CPU-fast; runs in "
+        "tier-1, deliberately NOT in the slow set)")
 
 
 @pytest.fixture(autouse=True)
@@ -198,7 +204,8 @@ def _lock_order_debug(request):
             or request.node.get_closest_marker("metrics")
             or request.node.get_closest_marker("quant")
             or request.node.get_closest_marker("handoff")
-            or request.node.get_closest_marker("disagg")):
+            or request.node.get_closest_marker("disagg")
+            or request.node.get_closest_marker("runtime")):
         yield
         return
     from deeplearning4j_tpu.analysis import instrument

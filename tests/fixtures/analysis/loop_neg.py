@@ -1,0 +1,63 @@
+"""Must-NOT-flag cases for conc-loop-ownership (graftcheck fixture —
+never imported, only parsed)."""
+import threading
+
+
+class CleanTickServer:
+    """Every write is either loop-exclusive or holds the declared loop
+    lock; reads of loop-owned attrs are exempt from conc-mixed-lock."""
+
+    _LOOP_OWNED = ("_slots", "_round")
+    _LOOP_LOCK = "_cond"
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._slots = {}
+        self._round = 0
+        self._loop = ServingLoop("clean", tick=self._tick)
+
+    def _tick(self):
+        # on the owning loop thread: lock-free writes are legal
+        self._round += 1
+        self._advance()
+        return True
+
+    def _advance(self):
+        # reachable ONLY from the loop root: still loop-exclusive
+        self._slots.clear()
+
+    def adopt(self, rid, page):
+        # off-thread write UNDER the declared loop lock: legal
+        with self._cond:
+            self._slots[rid] = page
+
+    def _reset_locked(self):
+        # private helper whose every call site holds the loop lock:
+        # entry-lock propagation keeps it clean
+        self._round = 0
+
+    def restart(self):
+        with self._cond:
+            self._reset_locked()
+
+    def snapshot(self):
+        with self._cond:
+            return dict(self._slots), self._round
+
+
+class Undeclared:
+    """No _LOOP_OWNED declaration: the rule stays silent even with a
+    thread target writing state (mixed-lock governs such classes)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        with self._lock:
+            self._n += 1
+
+    def count(self):
+        with self._lock:
+            return self._n

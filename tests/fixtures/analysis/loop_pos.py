@@ -1,0 +1,43 @@
+"""Must-flag cases for conc-loop-ownership (graftcheck fixture —
+never imported, only parsed)."""
+import threading
+
+
+class TickServer:
+    """Three conc-loop-ownership positives: loop-owned state written
+    off the owning loop thread without the declared loop lock."""
+
+    _LOOP_OWNED = ("_slots", "_round")
+    _LOOP_LOCK = "_cond"
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._slots = {}
+        self._round = 0
+        self._thread = threading.Thread(target=self._tick, daemon=True)
+
+    def _tick(self):
+        # loop-exclusive: lock-free writes on the owning thread are the
+        # whole point of the declaration — never flagged
+        self._round += 1
+        self._slots[self._round] = "run"
+        self._bump()
+        return True
+
+    def adopt(self, rid, page):
+        # POSITIVE conc-loop-ownership: a public caller thread mutates a
+        # loop-owned container without the loop lock
+        self._slots[rid] = page
+
+    def reset(self):
+        # POSITIVE conc-loop-ownership: off-thread write, no lock
+        self._round = 0
+
+    def kick(self):
+        # a public entry into the shared helper makes it NON-exclusive
+        self._bump()
+
+    def _bump(self):
+        # POSITIVE conc-loop-ownership: reachable from BOTH the loop
+        # root and a public method, so the write needs the loop lock
+        self._round += 1

@@ -509,7 +509,7 @@ class TestGenerationFailAllCloseRace:
         closer.join(timeout=120)
         assert not closer.is_alive()
         assert all(f.done() for f in futs)        # zero hung futures
-        assert not srv._thread.is_alive()         # loop truly stopped
+        assert srv._runtime.alive_workers == 0    # loop truly stopped
 
     def test_fail_all_still_rebuilds_on_live_server(self, lm):
         """Complement of the guard: on a server that is NOT shutting

@@ -114,66 +114,60 @@ class TestLifecycle:
         with pytest.raises(RuntimeError):
             inf.submit(_features(1))
 
-    def test_close_idempotent_and_without_submits(self):
-        net = _mln()
-        inf = ParallelInference(net, workers=8)
-        inf.close()
-        inf.close()
-
     def test_close_fails_requests_queued_behind_sentinel(self):
-        """Requests a racing submit() slipped into the queue behind the
+        """Requests a racing submit() slipped into the inbox behind the
         shutdown sentinel must be FAILED by close(), never left as futures
-        nobody will ever resolve. Staged deterministically: a pre-finished
-        dummy worker thread stands in for a coalescer that has already
-        exited at the sentinel."""
-        import queue
-        import threading
-
+        nobody will ever resolve. Staged deterministically: the coalescer
+        loop is closed first (its pool has exited at the sentinel), then
+        requests land in its inbox the way a racing put would."""
         from deeplearning4j_tpu.parallel import inference as inf_mod
 
         inf = ParallelInference(_mln(), workers=8)
-        dummy = threading.Thread(target=lambda: None)
-        dummy.start()
-        dummy.join()
-        inf._threads = [dummy]
-        inf._submit_q = queue.Queue()
-        inf._inflight_q = queue.Queue(maxsize=inf.inflight)
+        with inf._lock:
+            co = inf._ensure_workers()
+        co.close(timeout=5)  # the pool retires at the sentinel
         reqs = [inf_mod._Request(_features(1, seed=i), None)
                 for i in range(3)]
         for r in reqs:
-            inf._submit_q.put(r)
+            co._inbox.put(r)
         inf.close()
         for r in reqs:
             with pytest.raises(RuntimeError, match="closed"):
                 r.future.result(timeout=5)
-        assert inf._submit_q.empty()
+        assert co._inbox.empty()
 
     def test_submit_racing_close_resolves_future(self):
         """A submit that passes the closed check just before close() lands
-        still gets a resolved (failed) future instead of hanging forever."""
-        import queue
-        import threading
+        still gets a resolved (failed) future instead of hanging forever.
+        Staged deterministically: every runtime worker is retired first
+        (so nothing can serve the request), then close() is injected
+        between the submit's enqueue and its post-enqueue re-check."""
+        import time as _time
 
-        from deeplearning4j_tpu.parallel import inference as inf_mod
+        from deeplearning4j_tpu.parallel import runtime as rt
 
         inf = ParallelInference(_mln(), workers=8)
-        dummy = threading.Thread(target=lambda: None)
-        dummy.start()
-        dummy.join()
-        inf._threads = [dummy]
-        inf._submit_q = queue.Queue()
-        inf._inflight_q = queue.Queue(maxsize=inf.inflight)
-        orig_put = inf._submit_q.put
+        with inf._lock:
+            co = inf._ensure_workers()
+            cm = inf._completer
+        for loop in (co, cm):
+            for _ in range(loop.alive_workers):
+                loop._inbox.put(rt._RESIGN)
+        deadline = _time.monotonic() + 5
+        while (co.alive_workers or cm.alive_workers) \
+                and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        assert co.alive_workers == 0 and cm.alive_workers == 0
+        orig_put = co.put
 
-        def put_then_close(item, *a, **kw):
-            orig_put(item, *a, **kw)
-            # close() lands exactly between this submit's enqueue and its
-            # post-enqueue closed re-check (the sentinel's own put recurses
-            # here; only the first real request triggers the close)
-            if item is not inf_mod._SHUTDOWN and not inf._closed:
+        def put_then_close(item, timeout=None):
+            orig_put(item, timeout=timeout)
+            # close() lands exactly between this submit's enqueue and
+            # its post-enqueue closed re-check
+            if not inf._closed:
                 inf.close()
 
-        inf._submit_q.put = put_then_close
+        co.put = put_then_close
         fut = inf.submit(_features(1))
         with pytest.raises(RuntimeError, match="closed"):
             fut.result(timeout=5)

@@ -218,6 +218,46 @@ class TestChaosPolicy:
         assert run(3) == run(3)
         assert run(3) != run(4)
 
+    def test_shutdown_modes_at_zero_rate_keep_legacy_sequence(self):
+        # the PR-14 shutdown-phase draws are gated on their own rates:
+        # calling them at rate 0 consumes NO rng draws, so every chaos
+        # sequence recorded before they existed replays byte-identically
+        def run(call_new_hooks):
+            chaos = ChaosPolicy(seed=7, transient_rate=0.3, hard_rate=0.1)
+            fn = chaos.wrap(lambda: "ok")
+            out = []
+            for _ in range(30):
+                if call_new_hooks:
+                    chaos.drain_fault()      # rate 0: no draw, no fault
+                    chaos.sentinel_fault()
+                try:
+                    out.append(fn())
+                except TransientDispatchError:
+                    out.append("transient")
+                except RuntimeError:
+                    out.append("hard")
+            return out
+
+        assert run(True) == run(False)
+
+    def test_shutdown_mode_draws_are_seeded(self):
+        def seq(seed):
+            chaos = ChaosPolicy(seed=seed, kill_during_drain_rate=0.5,
+                                stall_sentinel_rate=0.5)
+            hits = []
+            for _ in range(40):
+                try:
+                    chaos.drain_fault()
+                    hits.append(False)
+                except BaseException:  # noqa: B036 — LoopKilled by design
+                    hits.append(True)
+            assert chaos.injected_drain_kill == sum(hits)
+            return hits
+
+        assert seq(5) == seq(5)
+        assert seq(5) != seq(6)
+        assert 0 < sum(seq(5)) < 40  # an actual mix at rate 0.5
+
     def test_rates_and_counters(self):
         chaos = ChaosPolicy(seed=0, transient_rate=0.5)
         fn = chaos.wrap(lambda: "ok")
