@@ -18,9 +18,12 @@ The static order (low acquires first, a thread may only acquire UP):
 rank  lock
 ====  =====================================
 10    StreamingBroker._lock
+15    NearestNeighborsServer._lock
+18    EmbeddingIndex._lock
 20    ParallelInference._lock
 25    ServingLoop._cond
-30    ParallelInference._drain_cv, GenerationServer._cond
+30    ParallelInference._drain_cv, GenerationServer._cond,
+      EmbeddingIndex._drain_cv
 35    ReplicaFleet._cond
 40    KerasBackendServer._lock
 55    LoopSupervisor._lock
@@ -34,7 +37,11 @@ The serving runtime slots in at 25: servers may touch their ServingLoop
 re-homed servers always call the runtime with NO server lock held — the
 runtime in turn invokes its callbacks (tick/handler/wake/on_death)
 outside ``_cond``, so wake hooks may notify server conditions (rank
-30/35) freely. ``ReplicaFleet._cond`` ranks above the replica servers'
+30/35) freely. The retrieval tier ranks lowest of the servers:
+``NearestNeighborsServer`` handlers call into ``EmbeddingIndex``
+(15 → 18) and the index's locked ``_ensure_workers`` starts/watches
+runtime loops (18 → 25 → 55).
+``ReplicaFleet._cond`` ranks above the replica servers'
 locks because replica completion callbacks run under a server lock and
 then take the fleet's. ``LoopSupervisor._lock`` ranks above every loop
 and server lock it can be entered under (watch() from a locked
@@ -162,6 +169,10 @@ class OrderedCondition(OrderedLock):
 #: class -> {attr: (rank, is_condition)}
 def _targets() -> Dict[type, Dict[str, Tuple[int, bool]]]:
     from deeplearning4j_tpu.modelimport.server import KerasBackendServer
+    from deeplearning4j_tpu.nearestneighbors.index import EmbeddingIndex
+    from deeplearning4j_tpu.nearestneighbors.server import (
+        NearestNeighborsServer,
+    )
     from deeplearning4j_tpu.parallel.fleet import ReplicaFleet
     from deeplearning4j_tpu.parallel.generation import GenerationServer
     from deeplearning4j_tpu.parallel.inference import ParallelInference
@@ -174,6 +185,8 @@ def _targets() -> Dict[type, Dict[str, Tuple[int, bool]]]:
 
     return {
         StreamingBroker: {"_lock": (10, False)},
+        NearestNeighborsServer: {"_lock": (15, False)},
+        EmbeddingIndex: {"_lock": (18, False), "_drain_cv": (30, True)},
         ParallelInference: {"_lock": (20, False), "_drain_cv": (30, True)},
         ServingLoop: {"_cond": (25, True)},
         GenerationServer: {"_cond": (30, True)},

@@ -59,6 +59,9 @@ HOT_FUNCTIONS = {
     "_snapshot_families",                         # /metrics scrape path
     "_proj",                                      # fused-dequant projection
     "_quantize_kv",                               # int8 KV write quantizer
+    "_knn_coalesce_once",                         # knn query coalescer
+    "_knn_dispatch_batch", "_dispatch_knn",       # knn search dispatch
+    "_knn_complete_loop",                         # knn completer fetch
 }
 
 SYNC_BUILTINS = {"float", "bool", "int"}

@@ -1,12 +1,13 @@
 """REST k-NN service (reference: deeplearning4j-nearestneighbor-server).
 
-``DeviceBruteForceIndex`` is re-exported lazily so host-only VPTree users
-never pay the jax import.
+``DeviceBruteForceIndex`` and ``EmbeddingIndex`` are re-exported lazily so
+host-only VPTree users never pay the jax import.
 """
 
 from deeplearning4j_tpu.nearestneighbors.server import NearestNeighborsServer
 
-__all__ = ["DeviceBruteForceIndex", "NearestNeighborsServer"]
+__all__ = ["DeviceBruteForceIndex", "EmbeddingIndex",
+           "NearestNeighborsServer"]
 
 
 def __getattr__(name):
@@ -16,4 +17,8 @@ def __getattr__(name):
         )
 
         return DeviceBruteForceIndex
+    if name == "EmbeddingIndex":
+        from deeplearning4j_tpu.nearestneighbors.index import EmbeddingIndex
+
+        return EmbeddingIndex
     raise AttributeError(name)

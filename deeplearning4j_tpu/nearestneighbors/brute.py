@@ -70,9 +70,19 @@ class DeviceBruteForceIndex:
         before the jitted kernel so streams of varying sizes compile
         O(log Q_max * log k_max) programs, not one per distinct (Q, k)
         (an XLA compile inside a REST handler is a multi-hundred-ms
-        stall); results are sliced back to the requested shape."""
+        stall); results are sliced back to the requested shape.
+
+        ``k`` above the point count is clamped to N (the result contract
+        is min(k, N) columns — ``lax.top_k`` with k > N would fail inside
+        the jit); ``k < 1`` or a non-integer k raises ``ValueError``
+        BEFORE dispatch (k=0 would silently bucket up to 1 and negative
+        k would mis-slice the result)."""
+        if isinstance(k, bool) or not isinstance(k, (int, np.integer)):
+            raise ValueError(f"k must be a positive integer, got {k!r}")
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
         q = np.atleast_2d(np.asarray(queries, np.float32))
-        k = min(k, self.n_points)
+        k = min(int(k), self.n_points)
         Q = q.shape[0]
         bucket = 1 << max(Q - 1, 0).bit_length()  # next power of two
         if bucket != Q:

@@ -188,6 +188,12 @@ def pytest_configure(config):
         "machine, LoopSupervisor crash recovery, shutdown-phase chaos, "
         "idempotent drain/close across all servers — CPU-fast; runs in "
         "tier-1, deliberately NOT in the slow set)")
+    config.addinivalue_line(
+        "markers",
+        "knn: retrieval serving tests (EmbeddingIndex exact/int8/IVF "
+        "stores, query coalescer parity, recall gates, hardened /knn "
+        "HTTP tier — CPU-fast; runs in tier-1, deliberately NOT in the "
+        "slow set)")
 
 
 @pytest.fixture(autouse=True)
@@ -205,7 +211,8 @@ def _lock_order_debug(request):
             or request.node.get_closest_marker("quant")
             or request.node.get_closest_marker("handoff")
             or request.node.get_closest_marker("disagg")
-            or request.node.get_closest_marker("runtime")):
+            or request.node.get_closest_marker("runtime")
+            or request.node.get_closest_marker("knn")):
         yield
         return
     from deeplearning4j_tpu.analysis import instrument

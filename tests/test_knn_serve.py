@@ -1,0 +1,500 @@
+"""Retrieval serving (nearestneighbors/index.py + the rebuilt server).
+
+The contracts under test:
+
+* the pure f32 ``EmbeddingIndex`` is BYTE-identical to
+  ``DeviceBruteForceIndex`` (same upload arithmetic, same pad/bucket
+  code, same ``_knn`` jit cache);
+* N one-row ``submit()`` calls coalesce into ONE fused matmul+top_k
+  dispatch and slice back bit-exactly;
+* the int8 store clears the recall gate at >=1.8x capacity and rebuilds
+  bit-identically after drain/close (deterministic host quantization);
+* IVF clears recall >= 0.95 vs exact on a clustered corpus;
+* the serving posture fails typed (DeadlineExceeded / ServerOverloaded /
+  CircuitOpen), never hangs, and drain/close loses ZERO futures;
+* batch-size churn never retraces past the pow2 program budget;
+* the hardened HTTP tier answers structured 400/404/413/429/503/504.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nearestneighbors.brute import DeviceBruteForceIndex
+from deeplearning4j_tpu.nearestneighbors.index import EmbeddingIndex
+from deeplearning4j_tpu.nearestneighbors.server import NearestNeighborsServer
+from deeplearning4j_tpu.parallel.resilience import (
+    ChaosPolicy,
+    CircuitBreaker,
+    CircuitOpen,
+    DeadlineExceeded,
+    RetryPolicy,
+    ServerOverloaded,
+)
+
+pytestmark = pytest.mark.knn
+
+
+def _corpus(n, d, seed=0):
+    return np.random.RandomState(seed).randn(n, d).astype(np.float32)
+
+
+def _clustered(n, d, centers=128, seed=0):
+    """Mixture of gaussians — the corpus shape IVF is built for (pure
+    noise spreads each query's neighbors over many cells and is the
+    pathological case for any partitioned index)."""
+    rs = np.random.RandomState(seed)
+    mu = rs.randn(centers, d).astype(np.float32) * 4.0
+    pts = mu[rs.randint(0, centers, n)] + rs.randn(n, d).astype(
+        np.float32) * 0.6
+    return pts.astype(np.float32)
+
+
+def _post(base, path, obj, raw=None):
+    """POST helper returning (status, parsed json) — error statuses
+    included instead of raised."""
+    data = raw if raw is not None else json.dumps(obj).encode()
+    req = urllib.request.Request(
+        base + path, data=data,
+        headers={"Content-Type": "application/json"})
+    try:
+        res = urllib.request.urlopen(req)
+        return res.status, json.loads(res.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+# ---------------------------------------------------------------------------
+# exact parity with DeviceBruteForceIndex
+# ---------------------------------------------------------------------------
+
+class TestExactParity:
+    @pytest.mark.parametrize("metric", ["euclidean", "cosine"])
+    def test_f32_byte_identical_to_brute(self, metric):
+        pts = _corpus(257, 12, seed=1)
+        qs = _corpus(19, 12, seed=2)
+        brute = DeviceBruteForceIndex(pts, metric=metric)
+        index = EmbeddingIndex(pts, metric)
+        for k in (1, 3, 7):
+            db, ib = brute.search_batch_arrays(qs, k)
+            de, ie = index.search_batch_arrays(qs, k)
+            assert np.array_equal(db, de), "distances diverged from brute"
+            assert np.array_equal(ib, ie), "indices diverged from brute"
+        # single-query VPTree-shaped entry agrees too
+        assert index.search(qs[0], 3) == brute.search(qs[0], 3)
+        index.close()
+
+    def test_k_above_n_clamps_on_both_backends(self):
+        pts = _corpus(10, 4)
+        brute = DeviceBruteForceIndex(pts)
+        index = EmbeddingIndex(pts)
+        db, ib = brute.search_batch_arrays(pts[:3], 999)
+        de, ie = index.search_batch_arrays(pts[:3], 999)
+        assert db.shape == de.shape == (3, 10)
+        assert np.array_equal(ib, ie)
+        index.close()
+
+    @pytest.mark.parametrize("bad_k", [0, -2, 2.5, "x", True])
+    def test_bad_k_typed_on_both_backends(self, bad_k):
+        pts = _corpus(10, 4)
+        brute = DeviceBruteForceIndex(pts)
+        index = EmbeddingIndex(pts)
+        with pytest.raises(ValueError):
+            brute.search_batch_arrays(pts[:2], bad_k)
+        with pytest.raises(ValueError):
+            index.search_batch_arrays(pts[:2], bad_k)
+        index.close()
+
+    def test_dims_mismatch_and_empty_typed(self):
+        index = EmbeddingIndex(_corpus(10, 4))
+        with pytest.raises(ValueError, match="dims mismatch"):
+            index.search_batch_arrays(np.zeros((2, 5), np.float32), 3)
+        index.close()
+        empty = EmbeddingIndex()
+        with pytest.raises(ValueError, match="empty"):
+            empty.search_batch_arrays(np.zeros((1, 4), np.float32), 1)
+        empty.close()
+
+
+# ---------------------------------------------------------------------------
+# the coalescer
+# ---------------------------------------------------------------------------
+
+class TestCoalescer:
+    def test_32_one_row_submits_are_one_dispatch_bit_exact(self):
+        """The headline: 32 concurrent one-row submits == ONE batched
+        device program, each caller's slice bit-identical to the
+        synchronous batched answer."""
+        pts = _corpus(300, 8, seed=3)
+        qs = _corpus(32, 8, seed=4)
+        index = EmbeddingIndex(pts, max_batch=32, max_wait_ms=100.0)
+        d_sync, i_sync = index.search_batch_arrays(qs, 5)  # also warms jit
+        before = index.stats()["dispatches"]
+        futs = [index.submit(qs[i:i + 1], 5) for i in range(32)]
+        outs = [f.result(timeout=60) for f in futs]
+        assert index.stats()["dispatches"] == before + 1, \
+            "one-row submits did not coalesce into a single dispatch"
+        for i, (d, ix) in enumerate(outs):
+            assert np.array_equal(d, d_sync[i:i + 1])
+            assert np.array_equal(ix, i_sync[i:i + 1])
+        st = index.stats()
+        assert st["completed"] == 32 and st["failed"] == 0
+        assert st["pending"] == 0
+        index.close()
+
+    def test_no_recompile_under_batch_churn(self):
+        """Arbitrary query-batch sizes stay inside the pow2 program
+        budget: O(log max_batch) programs, not one per size."""
+        pts = _corpus(200, 6, seed=5)
+        index = EmbeddingIndex(pts)
+        rs = np.random.RandomState(0)
+        for _ in range(40):
+            q = _corpus(int(rs.randint(1, 64)), 6, seed=int(rs.randint(99)))
+            index.search_batch_arrays(q, 8)
+        # sizes 1..64 bucket to {1,2,4,8,16,32,64}: at most 7 programs
+        assert index.stats()["programs"] <= 7, \
+            f"batch churn retraced: {index.stats()['programs']} programs"
+        index.close()
+
+    def test_mixed_k_submits_resolve_with_right_widths(self):
+        pts = _corpus(100, 5, seed=6)
+        index = EmbeddingIndex(pts, max_wait_ms=5.0)
+        futs = [index.submit(_corpus(2, 5, seed=i), k) for i, k in
+                enumerate([1, 3, 4, 7, 8])]
+        for f, k in zip(futs, [1, 3, 4, 7, 8]):
+            d, idx = f.result(timeout=60)
+            assert d.shape == (2, k) and idx.shape == (2, k)
+        index.close()
+
+
+# ---------------------------------------------------------------------------
+# int8 store
+# ---------------------------------------------------------------------------
+
+class TestInt8Store:
+    def test_recall_gate_and_capacity_ratio(self):
+        pts = _corpus(2048, 16, seed=7)
+        qs = _corpus(64, 16, seed=8)
+        f32 = EmbeddingIndex(pts, mesh=None)
+        q8 = EmbeddingIndex(pts, store="int8")
+        recall = q8.measure_recall(qs, k=10)
+        assert recall >= 0.9, f"int8 recall {recall} below gate"
+        assert q8.stats()["recall"] == pytest.approx(recall)
+        ratio = f32.resident_bytes / q8.resident_bytes
+        assert ratio >= 1.8, f"int8 capacity ratio {ratio:.2f} < 1.8"
+        f32.close()
+        q8.close()
+
+    def test_bit_identical_rebuild_after_drain_close(self):
+        """Deterministic host quantization: an index rebuilt from the
+        same points after a full drain/close answers bit-identically —
+        the durability story for a restarted replica."""
+        pts = _corpus(500, 16, seed=3)
+        qs = _corpus(16, 16, seed=9)
+        first = EmbeddingIndex(pts, store="int8")
+        first.submit(qs[:4], 5).result(timeout=60)
+        d1, i1 = first.search_batch_arrays(qs, 10)
+        assert first.drain(timeout=30)
+        # drain is a serving pause, not a store teardown: sync still works
+        d_mid, i_mid = first.search_batch_arrays(qs, 10)
+        assert np.array_equal(d1, d_mid) and np.array_equal(i1, i_mid)
+        first.close()
+        second = EmbeddingIndex(pts, store="int8")
+        d2, i2 = second.search_batch_arrays(qs, 10)
+        assert np.array_equal(d1, d2), "int8 rebuild not bit-identical"
+        assert np.array_equal(i1, i2)
+        second.close()
+
+
+# ---------------------------------------------------------------------------
+# IVF
+# ---------------------------------------------------------------------------
+
+class TestIVF:
+    def test_recall_gate_on_clustered_corpus(self):
+        pts = _clustered(4096, 16, seed=0)
+        # queries live near the indexed clusters (perturbed corpus rows)
+        rs = np.random.RandomState(1)
+        qs = pts[rs.choice(4096, 64, replace=False)] \
+            + rs.randn(64, 16).astype(np.float32) * 0.2
+        index = EmbeddingIndex(pts, partitions=64, nprobe=8,
+                               kmeans_iters=10, seed=0)
+        st = index.stats()
+        assert st["variant"] == "ivf" and st["partitions"] == 64
+        recall = index.measure_recall(qs, k=10)
+        assert recall >= 0.95, f"IVF recall {recall} below the 0.95 gate"
+        index.close()
+
+    def test_int8_ivf_composes(self):
+        pts = _clustered(2048, 16, seed=2)
+        rs = np.random.RandomState(3)
+        qs = pts[rs.choice(2048, 32, replace=False)] \
+            + rs.randn(32, 16).astype(np.float32) * 0.2
+        index = EmbeddingIndex(pts, store="int8", partitions=32, nprobe=8,
+                               kmeans_iters=10, seed=0)
+        recall = index.measure_recall(qs, k=10)
+        assert recall >= 0.9, f"int8 IVF recall {recall} below gate"
+        d, idx = index.search_batch_arrays(qs, 5)
+        assert d.shape == (32, 5)
+        assert (idx >= 0).all() and (idx < 2048).all()
+        index.close()
+
+
+# ---------------------------------------------------------------------------
+# mesh sharding (8 virtual CPU devices, conftest)
+# ---------------------------------------------------------------------------
+
+class TestMeshSharded:
+    def test_sharded_flat_agrees_with_unsharded(self):
+        from deeplearning4j_tpu.parallel.mesh import data_mesh
+        pts = _corpus(300, 8, seed=10)   # 300 pads to 304 on 8 devices
+        qs = _corpus(9, 8, seed=11)
+        plain = EmbeddingIndex(pts)
+        shard = EmbeddingIndex(pts, mesh=data_mesh(8))
+        dp, ip = plain.search_batch_arrays(qs, 7)
+        ds, is_ = shard.search_batch_arrays(qs, 7)
+        assert np.array_equal(ip, is_)
+        np.testing.assert_allclose(dp, ds, rtol=1e-5, atol=1e-5)
+        plain.close()
+        shard.close()
+
+    def test_sharded_int8_recall(self):
+        from deeplearning4j_tpu.parallel.mesh import data_mesh
+        pts = _corpus(1024, 16, seed=12)
+        qs = _corpus(32, 16, seed=13)
+        index = EmbeddingIndex(pts, store="int8", mesh=data_mesh(8))
+        assert index.measure_recall(qs, k=10) >= 0.9
+        index.close()
+
+
+# ---------------------------------------------------------------------------
+# typed failures — never a hang, never a silent loss
+# ---------------------------------------------------------------------------
+
+class TestTypedFailures:
+    def test_expired_deadline_is_deadline_exceeded(self):
+        pts = _corpus(100, 4)
+        index = EmbeddingIndex(pts, max_wait_ms=1.0)
+        fut = index.submit(pts[:1], 3, deadline_s=1e-6)
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=30)
+        assert index.stats()["expired"] >= 1
+        index.close()
+
+    def test_burst_beyond_watermark_sheds_typed(self):
+        pts = _corpus(100, 4)
+        chaos = ChaosPolicy(seed=0, latency_rate=1.0, latency_s=0.05)
+        index = EmbeddingIndex(pts, max_batch=4, max_wait_ms=1.0,
+                               inflight=1, max_pending=8, chaos=chaos)
+        index.search_batch_arrays(pts[:1], 3)  # warm the programs
+        admitted, shed = [], 0
+        for i in range(40):
+            try:
+                admitted.append(index.submit(_corpus(1, 4, seed=i), 3))
+            except ServerOverloaded:
+                shed += 1
+        assert shed > 0, "burst never hit the watermark"
+        for f in admitted:
+            d, idx = f.result(timeout=60)
+            assert d.shape == (1, 3)
+        st = index.stats()
+        assert st["rejected"] == shed
+        assert st["pending"] == 0
+        index.close()
+
+    def test_open_breaker_fast_fails_submits(self):
+        pts = _corpus(100, 4)
+        chaos = ChaosPolicy(seed=0, hard_rate=1.0)  # every dispatch dies
+        breaker = CircuitBreaker(failure_threshold=0.5, window=8,
+                                 min_calls=2, reset_timeout_s=60.0)
+        index = EmbeddingIndex(pts, max_wait_ms=1.0, chaos=chaos,
+                               breaker=breaker,
+                               retry=RetryPolicy(max_attempts=1))
+        saw_open = False
+        for i in range(12):
+            try:
+                fut = index.submit(pts[:1], 3)
+            except CircuitOpen:
+                saw_open = True
+                break
+            with pytest.raises(RuntimeError):
+                fut.result(timeout=30)
+        assert saw_open or breaker.state == CircuitBreaker.OPEN
+        with pytest.raises(CircuitOpen):
+            index.submit(pts[:1], 3)
+        st = index.stats()
+        assert st["breaker_state"] == "open"
+        assert st["rejected_circuit"] >= 1
+        index.close()
+
+
+# ---------------------------------------------------------------------------
+# lifecycle — drain/close loses nothing
+# ---------------------------------------------------------------------------
+
+class TestLifecycle:
+    def test_close_with_inflight_loses_zero_futures(self):
+        pts = _corpus(200, 6)
+        chaos = ChaosPolicy(seed=0, latency_rate=1.0, latency_s=0.02)
+        index = EmbeddingIndex(pts, max_batch=4, max_wait_ms=1.0,
+                               inflight=1, chaos=chaos)
+        futs = [index.submit(_corpus(1, 6, seed=i), 3) for i in range(16)]
+        index.close()
+        resolved = failed = 0
+        for f in futs:
+            assert f.done(), "close() left a future unresolved"
+            if f.exception() is None:
+                d, _ = f.result()
+                assert d.shape == (1, 3)
+                resolved += 1
+            else:
+                failed += 1
+        assert resolved + failed == 16
+        st = index.stats()
+        assert st["pending"] == 0
+        assert st["completed"] + st["failed"] == st["accepted"]
+
+    def test_submit_after_close_and_idempotent_close(self):
+        index = EmbeddingIndex(_corpus(50, 4))
+        index.submit(_corpus(1, 4), 3).result(timeout=60)
+        index.close()
+        index.close()  # idempotent
+        with pytest.raises(RuntimeError, match="closed"):
+            index.submit(_corpus(1, 4), 3)
+
+    def test_add_grows_store_and_serves_new_rows(self):
+        pts = _corpus(50, 4, seed=20)
+        index = EmbeddingIndex(pts)
+        assert index.n_points == 50
+        extra = _corpus(10, 4, seed=21)
+        assert index.add(extra) == 60
+        d, idx = index.search_batch_arrays(extra[:1], 1)
+        assert idx[0, 0] == 50  # its own row, freshly appended
+        assert d[0, 0] == pytest.approx(0.0, abs=1e-5)
+        index.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet compatibility
+# ---------------------------------------------------------------------------
+
+class TestFleetCompat:
+    def test_index_replicas_ride_the_fleet(self):
+        from deeplearning4j_tpu.parallel.fleet import ReplicaFleet
+        pts = _corpus(100, 4, seed=22)
+        fleet = ReplicaFleet(
+            lambda rid: EmbeddingIndex(pts, max_wait_ms=1.0), replicas=2)
+        try:
+            futs = [fleet.submit(pts[i:i + 1], 3) for i in range(8)]
+            for i, f in enumerate(futs):
+                d, idx = f.result(timeout=60)
+                assert idx[0, 0] == i  # each query finds its own row
+        finally:
+            fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# the hardened HTTP tier
+# ---------------------------------------------------------------------------
+
+class TestServerHardening:
+    def test_malformed_payloads_answer_structured_400(self):
+        pts = _corpus(20, 3, seed=30)
+        with NearestNeighborsServer(pts, port=0) as server:
+            base = f"http://127.0.0.1:{server.port}"
+            cases = [
+                {"k": 2},                                   # missing point
+                {"k": 2, "point": "zzz"},                   # non-numeric
+                {"k": 2, "points": [[1, 2, 3], [1, 2]]},    # ragged
+                {"k": "x", "point": [1, 2, 3]},             # bad k
+                {"k": 0, "point": [1, 2, 3]},               # k < 1
+                {"k": 2.5, "point": [1, 2, 3]},             # fractional k
+                {"k": 2, "point": [1, 2]},                  # dims mismatch
+                {"k": 2, "points": [1, 2, 3]},              # wrong ndim
+            ]
+            for body in cases:
+                status, res = _post(base, "/knn", body)
+                assert status == 400, f"{body} answered {status}"
+                assert res["error"] == "BadRequest"
+                assert res["detail"]
+            status, res = _post(base, "/knn", None,
+                                raw=b"this is not json")
+            assert status == 400
+            status, res = _post(base, "/knn", [1, 2, 3])  # not an object
+            assert status == 400
+            status, res = _post(base, "/nope", {"k": 1})
+            assert status == 404 and res["error"] == "NotFound"
+
+    def test_oversized_body_answers_413(self):
+        pts = _corpus(20, 3)
+        with NearestNeighborsServer(pts, port=0,
+                                    max_body_bytes=1024) as server:
+            base = f"http://127.0.0.1:{server.port}"
+            big = {"k": 1, "points": [[1.0, 2.0, 3.0]] * 5000}
+            status, res = _post(base, "/knn", big)
+            assert status == 413
+            assert res["error"] == "BodyTooLarge"
+
+    def test_stats_and_metrics_endpoints(self):
+        pts = _corpus(20, 3)
+        with NearestNeighborsServer(pts, port=0,
+                                    backend="index") as server:
+            base = f"http://127.0.0.1:{server.port}"
+            _post(base, "/knn", {"k": 1, "point": pts[0].tolist()})
+            st = json.loads(urllib.request.urlopen(base + "/stats").read())
+            assert st["backend"] == "index"
+            assert st["points"] == 20 and st["dims"] == 3
+            assert st["index"]["completed"] >= 1
+            text = urllib.request.urlopen(base + "/metrics").read().decode()
+            for name in ("knn_http_requests_total", "knn_latency_ms",
+                         "knn_resident_bytes", "knn_recall"):
+                assert name in text, f"{name} missing from /metrics"
+
+    def test_index_backend_end_to_end(self):
+        pts = _corpus(50, 3, seed=31)
+        with NearestNeighborsServer(pts, port=0, backend="index",
+                                    store="int8") as server:
+            base = f"http://127.0.0.1:{server.port}"
+            st = json.loads(urllib.request.urlopen(base + "/status").read())
+            assert st == {"points": 50, "dims": 3}
+            q = pts[7] + 0.001
+            status, res = _post(base, "/knn",
+                                {"k": 2, "point": q.tolist()})
+            assert status == 200
+            assert res["results"][0]["index"] == 7
+            status, res = _post(base, "/knnVector",
+                                {"k": 1, "points": [pts[3].tolist(),
+                                                    pts[9].tolist()]})
+            assert status == 200
+            assert [r[0]["index"] for r in res["results"]] == [3, 9]
+            # /encode with add=true grows the store
+            status, res = _post(base, "/encode",
+                                {"docs": [[9.0, 9.0, 9.0]], "add": True})
+            assert status == 200 and res["added"] == 1
+            st = json.loads(urllib.request.urlopen(base + "/status").read())
+            assert st["points"] == 51
+            status, res = _post(base, "/knn",
+                                {"k": 1, "point": [9.0, 9.0, 9.0]})
+            assert res["results"][0]["index"] == 50
+
+    def test_expired_deadline_maps_to_504(self):
+        pts = _corpus(50, 3)
+        with NearestNeighborsServer(pts, port=0, backend="index",
+                                    max_wait_ms=1.0) as server:
+            base = f"http://127.0.0.1:{server.port}"
+            status, res = _post(
+                base, "/knn",
+                {"k": 1, "point": pts[0].tolist(), "deadline_s": 1e-6})
+            assert status == 504
+            assert res["error"] == "DeadlineExceeded"
+
+    def test_encode_requires_index_backend(self):
+        pts = _corpus(20, 3)
+        with NearestNeighborsServer(pts, port=0) as server:
+            base = f"http://127.0.0.1:{server.port}"
+            status, res = _post(base, "/encode", {"docs": [[1, 2, 3]]})
+            assert status == 400
+            assert "backend" in res["detail"]
