@@ -329,6 +329,85 @@ def bench_attention_bwd(B: int = 4, H: int = 8, T: int = 2048, d: int = 128,
             _attn_chained_ms(flash, B, H, T, d, steps, "attention bwd"))
 
 
+def bench_paged_attn(B: int = 8, H: int = 8, d: int = 128,
+                     page_size: int = 16, steps: int = 16):
+    """Paged-attention decode read: the Pallas block-table kernel vs the
+    stock gather-then-attend XLA backend (the ``PagedAttentionHelper``
+    seam, nn/conf/layers/paged_attention.py), at a short (128-token) and
+    a long (2048-token) context, f32 and int8 pools. Decode shape: q is
+    ONE token per slot, so the gather the stock path materialises per
+    read is pure overhead the kernel deletes — tokens/s here is
+    ``B * calls / wall``. Chained serial timing (each call's output is
+    the next call's query) so queue pipelining cannot hide latency.
+    Off-TPU the kernel leg runs in interpret mode — the parity
+    configuration, not a perf path — and the geometry shrinks to keep
+    the interpreter affordable; the context lengths stay 128/2048
+    either way."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.conf.layers.paged_attention import (
+        paged_attend)
+
+    if jax.default_backend() != "tpu":
+        B, H, d, steps = 2, 2, 64, 4
+
+    def quantize(t):
+        m = jnp.max(jnp.abs(t), axis=-1)
+        scale = (m / 127.0).astype(jnp.float32)
+        safe = jnp.where(scale > 0, scale, 1.0).astype(t.dtype)
+        q8 = jnp.clip(jnp.round(t / safe[..., None]),
+                      -127, 127).astype(jnp.int8)
+        return q8, scale
+
+    def chain_tokens_s(g, q, args, n):
+        _sync(g(q, *args))  # compile + warm
+        while True:
+            t0 = time.perf_counter()
+            o = q
+            for _ in range(n):
+                o = g(o, *args)
+            _sync(o)
+            total = time.perf_counter() - t0
+            if total >= MIN_MARGINAL_WINDOW_S:
+                return B * n / total
+            n *= 2  # below timer resolution: widen the window
+
+    out = {}
+    rs = np.random.RandomState(11)
+    for ctx in (128, 2048):
+        NP = ctx // page_size
+        P = B * NP + 1  # + the garbage page
+        q = jnp.asarray(rs.randn(B, H, 1, d), jnp.float32)
+        kf = jnp.asarray(rs.randn(P, H, page_size, d), jnp.float32)
+        vf = jnp.asarray(rs.randn(P, H, page_size, d), jnp.float32)
+        # distinct pages per slot, decode position at the full context
+        bt = jnp.asarray(rs.permutation(P - 1)[:B * NP].reshape(B, NP)
+                         + 1, jnp.int32)
+        pos = jnp.full((B,), ctx - 1, jnp.int32)
+        for quant in (False, True):
+            if quant:
+                kp, ksp = quantize(kf)
+                vp, vsp = quantize(vf)
+            else:
+                kp, vp, ksp, vsp = kf, vf, None, None
+            key = f"paged_attn_t{ctx}" + ("_int8" if quant else "")
+            rates = {}
+            for name, backend in (("xla", "xla"), ("kernel", "pallas")):
+                # pools/tables are jit ARGUMENTS (device-resident, as in
+                # serving) — closing over them would bake them into the
+                # program as constants
+                g = jax.jit(lambda qq, kkp, vvp, bbt, ppos, kks, vvs,
+                            _b=backend: paged_attend(
+                                _b, qq, kkp, vvp, bbt, ppos,
+                                kscales=kks, vscales=vvs))
+                rates[name] = chain_tokens_s(
+                    g, q, (kp, vp, bt, pos, ksp, vsp), steps)
+                out[f"{key}_{name}_tokens_s"] = rates[name]
+            out[f"{key}_kernel_speedup"] = rates["kernel"] / rates["xla"]
+    return out
+
+
 def bench_fit_e2e(batch: int = 1, n_examples: int = 96, reps: int = 5):
     """LeNet-MNIST ``fit()`` wall clock, END TO END — the user-facing path
     the marginal timer deliberately cancels out of the chip metrics: per
@@ -2212,6 +2291,14 @@ SANITY_CEILING = {
     "knn_serve_q_s": 1e8,
     "knn_serve_serial_q_s": 1e8,
     "knn_serve_ivf_q_s": 1e8,
+    "paged_attn_t128_xla_tokens_s": 1e9,
+    "paged_attn_t128_kernel_tokens_s": 1e9,
+    "paged_attn_t128_int8_xla_tokens_s": 1e9,
+    "paged_attn_t128_int8_kernel_tokens_s": 1e9,
+    "paged_attn_t2048_xla_tokens_s": 1e9,
+    "paged_attn_t2048_kernel_tokens_s": 1e9,
+    "paged_attn_t2048_int8_xla_tokens_s": 1e9,
+    "paged_attn_t2048_int8_kernel_tokens_s": 1e9,
     "vgg16_bf16_img_s": 1e5,
     "textgen_lstm_tokens_s": 1e9,
     "transformer_lm_tokens_s": 1e9,
@@ -2351,6 +2438,18 @@ METRIC_UNIT = {
     "attention_t4096_stock_ms": "ms",
     "attention_t4096_flash_ms": "ms",
     "attention_flash_speedup": "x",
+    "paged_attn_t128_xla_tokens_s": "tokens/s",
+    "paged_attn_t128_kernel_tokens_s": "tokens/s",
+    "paged_attn_t128_kernel_speedup": "x",
+    "paged_attn_t128_int8_xla_tokens_s": "tokens/s",
+    "paged_attn_t128_int8_kernel_tokens_s": "tokens/s",
+    "paged_attn_t128_int8_kernel_speedup": "x",
+    "paged_attn_t2048_xla_tokens_s": "tokens/s",
+    "paged_attn_t2048_kernel_tokens_s": "tokens/s",
+    "paged_attn_t2048_kernel_speedup": "x",
+    "paged_attn_t2048_int8_xla_tokens_s": "tokens/s",
+    "paged_attn_t2048_int8_kernel_tokens_s": "tokens/s",
+    "paged_attn_t2048_int8_kernel_speedup": "x",
     "attention_bwd_t2048_stock_ms": "ms",
     "attention_bwd_t2048_flash_ms": "ms",
     "attention_bwd_flash_speedup": "x",
@@ -2563,7 +2662,8 @@ class _HeadlineSampler:
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     valid = ("all", "resnet50", "vgg16", "lenet", "lstm", "transformer",
-             "word2vec", "doc2vec", "attention", "fit_e2e", "eval_e2e",
+             "word2vec", "doc2vec", "attention", "paged_attn",
+             "fit_e2e", "eval_e2e",
              "guard_overhead", "metrics_overhead", "inference_serve",
              "serve_chaos", "serve_fleet", "serve_handoff", "serve_disagg",
              "serve_soak", "serve_restart",
@@ -2671,6 +2771,8 @@ def main():
         headline and headline.sample("post-doc2vec")
     if which in ("all", "attention"):
         _sub_metric(extras, "attention", _attention_metrics)
+    if which in ("all", "paged_attn"):
+        _sub_metric(extras, "paged_attn", bench_paged_attn)
         headline and headline.sample("post-attention")
         _sub_metric(extras, "attention_bwd", _attention_bwd_metrics)
         _sub_metric(extras, "attention_bwd_long",

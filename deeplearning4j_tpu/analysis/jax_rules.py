@@ -62,6 +62,9 @@ HOT_FUNCTIONS = {
     "_knn_coalesce_once",                         # knn query coalescer
     "_knn_dispatch_batch", "_dispatch_knn",       # knn search dispatch
     "_knn_complete_loop",                         # knn completer fetch
+    "_paged_forward",                             # paged-KV decode read+write
+    "paged_attend",                               # helper-seam dispatch
+    "resolve_paged_backend",                      # helper-seam selection
 }
 
 SYNC_BUILTINS = {"float", "bool", "int"}

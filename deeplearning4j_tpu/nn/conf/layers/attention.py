@@ -10,6 +10,7 @@ lives in parallel/sequence.py.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 
 import jax
@@ -20,6 +21,23 @@ from deeplearning4j_tpu.nn.conf.layers.base import BaseLayer, Layer
 from deeplearning4j_tpu.utils.serde import register_serializable
 
 NEG_INF = -1e30
+
+
+def _debug_paged_overflow(pos, T, NP, ps):
+    """Debug-mode paged-capacity assert (DL4J_TPU_PAGED_DEBUG=1). In
+    production the check lives in the CALLER's page-accounting admission
+    (GenerationServer.submit/adopt know the budget before dispatch); the
+    per-dispatch ``int(jnp.max(pos))`` here is a device→host sync the hot
+    decode loop must not pay, so it is opt-in only."""
+    if os.environ.get("DL4J_TPU_PAGED_DEBUG") != "1":
+        return
+    if isinstance(pos, jax.core.Tracer):
+        return
+    hi = int(jnp.max(pos))
+    if hi + T > NP * ps:
+        raise ValueError(
+            f"paged KV overflow: position {hi} + {T} new tokens > "
+            f"block table capacity {NP} pages x {ps} = {NP * ps}")
 
 
 def scaled_dot_attention(q, k, v, *, causal: bool = False, mask=None):
@@ -62,6 +80,13 @@ class SelfAttentionLayer(BaseLayer):
     # (incl. [B,T] key masks since round 5; T divisible by its block),
     # "pallas" forces it, "stock" forces the XLA softmax(QK^T)V path.
     helper: str = "auto"
+    # Paged-decode read backend (the PagedAttentionHelper seam,
+    # nn/conf/layers/paged_attention.py): "auto" walks the block table
+    # with the Pallas kernel on TPU and falls back to the gather-then-
+    # attend XLA path elsewhere; "pallas"/"xla" force a backend (forced
+    # pallas off-TPU runs in interpret mode — the CI parity config).
+    # Resolution is trace-time static; serving program caches key on it.
+    paged_attention: str = "auto"
 
     INPUT_KIND = "rnn"
     DEFAULT_ACTIVATION = "identity"
@@ -360,10 +385,21 @@ class SelfAttentionLayer(BaseLayer):
           - ``cache_pos``: ``[B]`` per-row stream positions, exactly as in
             the per-row ``_streaming_forward`` path.
 
-        The attention math is the dense per-row path verbatim over the
-        gathered ``[B, H, n_pages*page_size, d]`` view, so outputs are
-        bit-identical to a contiguous cache of capacity
-        ``n_pages * page_size`` holding the same tokens.
+        The attend over the resident pages routes through the
+        PagedAttentionHelper seam (nn/conf/layers/paged_attention.py):
+        the XLA backend attends over the gathered
+        ``[B, H, n_pages*page_size, d]`` view — the dense per-row path
+        verbatim, so outputs are bit-identical to a contiguous cache of
+        capacity ``n_pages * page_size`` holding the same tokens — and
+        the Pallas backend reads pages in place via the block table,
+        parity-pinned bitwise against the XLA path. The chunk WRITE
+        below never enters the seam: every backend sees the same
+        scatter, garbage-page routing and COW contract.
+
+        Capacity is the caller's page-accounting admission to enforce
+        (GenerationServer budgets pages before dispatch); set
+        ``DL4J_TPU_PAGED_DEBUG=1`` to re-enable the per-dispatch
+        host-sync overflow assert when debugging a new caller.
         """
         B, T, _ = x.shape
         kp, vp = state["kpages"], state["vpages"]
@@ -374,13 +410,7 @@ class SelfAttentionLayer(BaseLayer):
                              f"cache_pos, got shape {getattr(pos, 'shape', ())}")
         ps = kp.shape[2]
         NP = bt.shape[1]
-        Tmax = NP * ps
-        if not isinstance(pos, jax.core.Tracer):
-            hi = int(jnp.max(pos))
-            if hi + T > Tmax:
-                raise ValueError(
-                    f"paged KV overflow: position {hi} + {T} new tokens > "
-                    f"block table capacity {NP} pages x {ps} = {Tmax}")
+        _debug_paged_overflow(pos, T, NP, ps)
         if mask is not None:
             mask = jnp.asarray(mask)
             if mask.shape != (B, T):
@@ -421,30 +451,17 @@ class SelfAttentionLayer(BaseLayer):
         if quant:
             ksp = ksp.at[pg, :, off].set(ksc.transpose(0, 2, 1))
             vsp = vsp.at[pg, :, off].set(vsc.transpose(0, 2, 1))
-        # gather each row's logical cache view: [B,NP,H,ps,d] -> [B,H,Tmax,d]
-        kc = kp[bt].transpose(0, 2, 1, 3, 4).reshape(B, -1, Tmax, kp.shape[-1])
-        vc = vp[bt].transpose(0, 2, 1, 3, 4).reshape(B, -1, Tmax, vp.shape[-1])
-        if quant:
-            ksv = ksp[bt].transpose(0, 2, 1, 3).reshape(B, -1, Tmax)
-            vsv = vsp[bt].transpose(0, 2, 1, 3).reshape(B, -1, Tmax)
-            kc = kc.astype(q.dtype) * ksv[..., None].astype(q.dtype)
-            vc = vc.astype(q.dtype) * vsv[..., None].astype(q.dtype)
-        d = q.shape[-1]
-        logits = jnp.einsum("bhtd,bhkd->bhtk", q, kc) / jnp.sqrt(
-            jnp.asarray(d, q.dtype))
-        col = jnp.arange(Tmax)[None, None, None, :]
-        row = jnp.arange(T)[None, None, :, None]
-        logits = jnp.where(col <= pos.reshape(-1, 1, 1, 1) + row,
-                           logits, NEG_INF)
-        if mask is not None:
-            colv = jnp.arange(Tmax)[None, :]
-            rel = colv - pos[:, None]                            # [B,Tmax]
-            chunk_valid = jnp.take_along_axis(
-                mask.astype(bool), jnp.clip(rel, 0, T - 1), axis=1)
-            key_valid = jnp.where((rel >= 0) & (rel < T), chunk_valid, True)
-            logits = jnp.where(key_valid[:, None, None, :], logits, NEG_INF)
-        o = jnp.einsum("bhtk,bhkd->bhtd",
-                       jax.nn.softmax(logits, axis=-1), vc)
+        # read side: attend over the resident pages through the selected
+        # helper backend. Resolution is trace-time static (the knob is
+        # host config, the geometry is shapes), so each backend family
+        # traces its own program — never a retrace hazard.
+        from deeplearning4j_tpu.nn.conf.layers import paged_attention as ppa
+
+        backend = ppa.resolve_paged_backend(
+            self.paged_attention, page_size=ps,
+            head_dim=self.n_out // self.n_heads, n_pages=NP, quant=quant)
+        o = ppa.paged_attend(backend, q, kp, vp, bt, pos, mask=mask,
+                             kscales=ksp, vscales=vsp)
         o = o.transpose(0, 2, 1, 3).reshape(B, T, self.n_out)
         out = self._proj(params, o, "Wo", "bto,op->btp") + params["b"]
         if mask is not None:

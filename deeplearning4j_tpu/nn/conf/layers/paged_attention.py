@@ -1,0 +1,320 @@
+"""Paged-attention helper seam: XLA fallback + Pallas block-table kernel.
+
+The reference ships accelerated layer math behind ``*Helper`` seams with an
+always-available stock fallback (ConvolutionLayer.java:68-79 reflective
+cuDNN load; helper-vs-stock parity tests under deeplearning4j-cuda/). This
+module is that seam for the paged-KV decode path, the hottest serving loop
+in the repo:
+
+- :class:`XlaPagedAttention` — the stock backend. Gathers each row's block
+  table into a dense ``[B, H, Tmax, d]`` view and attends; this IS the math
+  that used to live inline in ``SelfAttentionLayer._paged_forward``, so it
+  is bit-exact by construction and runs anywhere XLA does.
+- :class:`PallasPagedAttention` — the accelerated backend. A Pallas kernel
+  that walks the block table via scalar prefetch and streams K/V pages from
+  the pool straight into VMEM (no materialized ``[B, H, Tmax, d]`` gather in
+  HBM — the gather cost that dominates long-context decode). int8 dequant
+  against the f32 ``kscales``/``vscales`` planes happens in-kernel as pages
+  load; per-row ``cache_pos`` causal masking and the chunk-validity plane
+  use the same expressions as the stock path, so interpret-mode output is
+  bitwise identical to it (tests/test_paged_attention.py pins this).
+
+Selection is per-platform: ``resolve_paged_backend("auto")`` picks the
+kernel on TPU when :func:`supports` accepts the geometry and the stock path
+everywhere else. CPU CI exercises the kernel in ``interpret=True`` mode for
+parity gating only — interpret mode is not a performance path.
+
+Only the READ side (attend over resident pages) lives behind the seam. The
+write side — scattering the fresh chunk through the block table, including
+the garbage-page-0 routing for masked columns — stays shared in
+``_paged_forward`` so COW/prefix-sharing/snapshot semantics are identical
+under every backend.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+#: per-(b, h) program VMEM budget: two f32 [Tmax, d] K/V scratch rows plus
+#: the [T, Tmax] score matrix must fit; same empirical v5e ceiling family
+#: as ops/pallas_attention.supports (4096x128 compiles, 8192x128 does not)
+VMEM_ROW_CEILING = 1 << 19
+
+BACKENDS = ("xla", "pallas")
+CHOICES = ("auto",) + BACKENDS
+
+
+def _key_valid_plane(mask, pos, T, Tmax):
+    """[B, Tmax] key validity over the cache axis for a masked chunk:
+    columns belonging to this chunk take the chunk mask, everything older
+    stays valid. Shared by both backends (the Pallas kernel consumes the
+    plane as an input) so the masking arithmetic cannot drift."""
+    colv = jnp.arange(Tmax)[None, :]
+    rel = colv - pos[:, None]                                # [B, Tmax]
+    chunk_valid = jnp.take_along_axis(
+        mask.astype(bool), jnp.clip(rel, 0, T - 1), axis=1)
+    return jnp.where((rel >= 0) & (rel < T), chunk_valid, True)
+
+
+class PagedAttentionHelper:
+    """One paged-attention read backend: attend a ``[B, H, T, d]`` query
+    chunk over the pool pages its block table names. ``attend`` returns
+    the pre-projection context ``[B, H, T, d]``; writing the fresh chunk
+    into the pool is NOT the helper's job (the seam covers reads only)."""
+
+    name = "base"
+
+    def attend(self, q, kp, vp, bt, pos, *, mask=None,
+               kscales=None, vscales=None):
+        raise NotImplementedError
+
+
+class XlaPagedAttention(PagedAttentionHelper):
+    """Stock backend: gather-then-attend, verbatim the math that shipped
+    inline in ``_paged_forward`` — the always-available fallback every
+    accelerated backend must match bit-for-bit."""
+
+    name = "xla"
+
+    def attend(self, q, kp, vp, bt, pos, *, mask=None,
+               kscales=None, vscales=None):
+        B, _H, T, d = q.shape
+        ps = kp.shape[2]
+        NP = bt.shape[1]
+        Tmax = NP * ps
+        # gather each row's logical cache view:
+        # [B,NP,H,ps,d] -> [B,H,Tmax,d]
+        kc = kp[bt].transpose(0, 2, 1, 3, 4).reshape(B, -1, Tmax,
+                                                     kp.shape[-1])
+        vc = vp[bt].transpose(0, 2, 1, 3, 4).reshape(B, -1, Tmax,
+                                                     vp.shape[-1])
+        if kscales is not None:
+            ksv = kscales[bt].transpose(0, 2, 1, 3).reshape(B, -1, Tmax)
+            vsv = vscales[bt].transpose(0, 2, 1, 3).reshape(B, -1, Tmax)
+            kc = kc.astype(q.dtype) * ksv[..., None].astype(q.dtype)
+            vc = vc.astype(q.dtype) * vsv[..., None].astype(q.dtype)
+        logits = jnp.einsum("bhtd,bhkd->bhtk", q, kc) / jnp.sqrt(
+            jnp.asarray(d, q.dtype))
+        col = jnp.arange(Tmax)[None, None, None, :]
+        row = jnp.arange(T)[None, None, :, None]
+        logits = jnp.where(col <= pos.reshape(-1, 1, 1, 1) + row,
+                           logits, NEG_INF)
+        if mask is not None:
+            key_valid = _key_valid_plane(mask, pos, T, Tmax)
+            logits = jnp.where(key_valid[:, None, None, :], logits,
+                               NEG_INF)
+        return jnp.einsum("bhtk,bhkd->bhtd",
+                          jax.nn.softmax(logits, axis=-1), vc)
+
+
+def _paged_attn_kernel(bt_ref, pos_ref, *refs, T, d, ps, NP, quant,
+                       has_mask):
+    """One (b, h, page) grid step. The BlockSpec index maps already
+    resolved ``bt[b, i]`` through scalar prefetch, so ``kp_ref``/``vp_ref``
+    hold THIS row's i-th logical page ``[ps, d]`` — the pool is never
+    gathered in HBM. Pages accumulate (dequantized) into VMEM scratch;
+    the final page step runs the whole attention row. The scores use the
+    exact expressions of the stock path (full dot, max-subtract softmax —
+    NOT the online/flash recurrence) so interpret-mode output is bitwise
+    identical to :class:`XlaPagedAttention`."""
+    if quant:
+        if has_mask:
+            (q_ref, kp_ref, vp_ref, ks_ref, vs_ref, kv_ref, o_ref,
+             k_sc, v_sc) = refs
+        else:
+            (q_ref, kp_ref, vp_ref, ks_ref, vs_ref, o_ref,
+             k_sc, v_sc) = refs
+    else:
+        if has_mask:
+            q_ref, kp_ref, vp_ref, kv_ref, o_ref, k_sc, v_sc = refs
+        else:
+            q_ref, kp_ref, vp_ref, o_ref, k_sc, v_sc = refs
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+    Tmax = NP * ps
+    k_pg = kp_ref[...].astype(jnp.float32)
+    v_pg = vp_ref[...].astype(jnp.float32)
+    if quant:
+        # in-kernel dequant: int8 page values widen against the page's
+        # f32 scale row as it lands in VMEM — elementwise identical to
+        # the stock path's post-gather dequant
+        k_pg = k_pg * ks_ref[...][:, None]
+        v_pg = v_pg * vs_ref[...][:, None]
+    k_sc[pl.ds(i * ps, ps), :] = k_pg
+    v_sc[pl.ds(i * ps, ps), :] = v_pg
+
+    @pl.when(i == NP - 1)
+    def _attend():
+        q = q_ref[...].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_sc[...], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) / jnp.sqrt(
+                jnp.asarray(d, jnp.float32))
+        col = jax.lax.broadcasted_iota(jnp.int32, (T, Tmax), 1)
+        row = jax.lax.broadcasted_iota(jnp.int32, (T, Tmax), 0)
+        # per-row cache_pos causal mask: garbage pages (unallocated /
+        # page-0 slots in the table) sit past pos+row and mask out here
+        s = jnp.where(col <= pos_ref[b] + row, s, NEG_INF)
+        if has_mask:
+            s = jnp.where(kv_ref[...] != 0, s, NEG_INF)
+        w = jax.nn.softmax(s, axis=-1)
+        o_ref[...] = jax.lax.dot_general(
+            w, v_sc[...], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+
+def _pallas_paged_attention(q, kp, vp, bt, pos, key_valid, kscales,
+                            vscales, *, interpret):
+    B, H, T, d = q.shape
+    ps = kp.shape[2]
+    NP = bt.shape[1]
+    Tmax = NP * ps
+    quant = kscales is not None
+    has_mask = key_valid is not None
+    kernel = functools.partial(_paged_attn_kernel, T=T, d=d, ps=ps, NP=NP,
+                               quant=quant, has_mask=has_mask)
+    # index maps receive (*grid, *prefetch_refs); the page maps pick pool
+    # page bt[b, i] per grid step — the block-table walk lives HERE
+    in_specs = [
+        pl.BlockSpec((None, None, T, d),
+                     lambda b, h, i, bt, pos: (b, h, 0, 0)),
+        pl.BlockSpec((None, None, ps, d),
+                     lambda b, h, i, bt, pos: (bt[b, i], h, 0, 0)),
+        pl.BlockSpec((None, None, ps, d),
+                     lambda b, h, i, bt, pos: (bt[b, i], h, 0, 0)),
+    ]
+    args = [q, kp, vp]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((None, None, ps),
+                         lambda b, h, i, bt, pos: (bt[b, i], h, 0)),
+            pl.BlockSpec((None, None, ps),
+                         lambda b, h, i, bt, pos: (bt[b, i], h, 0)),
+        ]
+        args += [kscales, vscales]
+    if has_mask:
+        in_specs.append(pl.BlockSpec((None, Tmax),
+                                     lambda b, h, i, bt, pos: (b, 0)))
+        args.append(key_valid.astype(jnp.float32))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, H, NP),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((None, None, T, d),
+                               lambda b, h, i, bt, pos: (b, h, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((Tmax, d), jnp.float32),
+                        pltpu.VMEM((Tmax, d), jnp.float32)],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, T, d), q.dtype),
+        interpret=interpret,
+    )(bt, pos.astype(jnp.int32), *args)
+
+
+class PallasPagedAttention(PagedAttentionHelper):
+    """Accelerated backend: block-table-walking Pallas kernel.
+
+    ``interpret=None`` auto-selects interpreter mode off-TPU (the CPU CI
+    parity configuration); pass ``False`` to require a real Mosaic
+    compile."""
+
+    name = "pallas"
+
+    def __init__(self, interpret=None):
+        self.interpret = interpret
+
+    def attend(self, q, kp, vp, bt, pos, *, mask=None,
+               kscales=None, vscales=None):
+        interpret = self.interpret
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        T = q.shape[2]
+        Tmax = bt.shape[1] * kp.shape[2]
+        key_valid = None
+        if mask is not None:
+            # the chunk-validity plane is tiny [B, Tmax] XLA math shared
+            # with the stock path; the kernel consumes it as an input
+            key_valid = _key_valid_plane(mask, pos, T, Tmax)
+        return _pallas_paged_attention(q, kp, vp, bt, pos, key_valid,
+                                       kscales, vscales,
+                                       interpret=interpret)
+
+
+_HELPERS = {
+    "xla": XlaPagedAttention(),
+    "pallas": PallasPagedAttention(),
+}
+
+
+def supports(*, page_size, head_dim, n_pages, quant=False,
+             platform=None):
+    """Can the Pallas backend take this pool geometry on this platform?
+    Used by ``auto`` selection only — a forced ``"pallas"`` knob (the CPU
+    interpret-mode parity tests) bypasses it."""
+    if platform is None:
+        platform = jax.default_backend()
+    if platform != "tpu":
+        # off-TPU the kernel would run interpreted — a debugging mode,
+        # never a serving win: auto falls back to stock
+        return False
+    # Mosaic tiling: page rows land in VMEM scratch at sublane offsets
+    # i*ps, and head_dim is the lane dimension of every block
+    if page_size % 8 or head_dim % 64:
+        return False
+    # both K and V scratch rows (and the [T, Tmax] score matrix) must
+    # fit in the per-program VMEM budget
+    if n_pages * page_size * head_dim > VMEM_ROW_CEILING:
+        return False
+    return True
+
+
+def resolve_paged_backend(choice, *, page_size, head_dim, n_pages,
+                          quant=False, platform=None):
+    """Resolve a ``paged_attention`` knob to a concrete backend name.
+
+    ``choice``: "auto" (Pallas on TPU when :func:`supports` accepts the
+    geometry, XLA everywhere else), or a forced "xla"/"pallas". The
+    result is a trace-time constant — callers key program caches on it so
+    backend families never share traces. The knob must be host config,
+    never data: choosing on a traced value would retrace per value (the
+    graftcheck jax-retrace-hazard rule flags that pattern)."""
+    if isinstance(choice, jax.core.Tracer):
+        raise TypeError(
+            "paged_attention backend must be static host config, got a "
+            "traced value — branching on it would retrace per value")
+    if choice not in CHOICES:
+        raise ValueError(f"unknown paged_attention backend {choice!r} "
+                         f"(expected one of {CHOICES})")
+    if choice != "auto":
+        return choice
+    if supports(page_size=page_size, head_dim=head_dim, n_pages=n_pages,
+                quant=quant, platform=platform):
+        return "pallas"
+    return "xla"
+
+
+def get_paged_helper(backend) -> PagedAttentionHelper:
+    try:
+        return _HELPERS[backend]
+    except KeyError:
+        raise ValueError(f"unknown paged_attention backend {backend!r} "
+                         f"(expected one of {BACKENDS})") from None
+
+
+def paged_attend(backend, q, kp, vp, bt, pos, *, mask=None,
+                 kscales=None, vscales=None):
+    """Dispatch one paged-attention read through the selected backend.
+    ``backend`` is a resolved name (see :func:`resolve_paged_backend`),
+    static at trace time."""
+    helper = get_paged_helper(backend)
+    return helper.attend(q, kp, vp, bt, pos, mask=mask,
+                         kscales=kscales, vscales=vscales)

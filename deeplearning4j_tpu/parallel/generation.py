@@ -243,6 +243,7 @@ class GenerationServer:
                  prefix_cache: bool = True,
                  steps_per_dispatch: int = 4,
                  kv_dtype: Optional[str] = None,
+                 paged_attention: Optional[str] = None,
                  draft_net=None,
                  spec_k: int = 4,
                  snapshot_every: int = 0,
@@ -271,6 +272,16 @@ class GenerationServer:
         if kv_dtype not in (None, "int8"):
             raise ValueError(f"unsupported kv_dtype {kv_dtype!r} "
                              "(None or 'int8')")
+        # paged-attention read backend (the PagedAttentionHelper seam):
+        # None leaves each layer's own ``paged_attention`` knob in place;
+        # "auto"/"xla"/"pallas" is pushed onto every paged layer in
+        # _probe_net. The RESOLVED backend tags every serving program
+        # cache key so xla/pallas families never share traces.
+        if paged_attention not in (None, "auto", "xla", "pallas"):
+            raise ValueError(
+                f"unsupported paged_attention {paged_attention!r} "
+                "(None, 'auto', 'xla' or 'pallas')")
+        self.paged_attention = paged_attention
         self.prefix_cache = bool(prefix_cache)
         self.steps_per_dispatch = int(steps_per_dispatch)
         self.kv_dtype = kv_dtype
@@ -552,6 +563,7 @@ class GenerationServer:
         self._paged_names: list = []
         self._pos_names: list = []
         self._layer_by_name: dict = {}
+        self._pa_prev: dict = {}
         self._page_token_bytes = 0
         # admission accounting must track the CACHE dtype, not the conf
         # dtype: int8 pages store 1-byte values plus one f32 scale per
@@ -569,6 +581,16 @@ class GenerationServer:
                 continue
             self._layer_by_name[name] = layer
             if "kcache" in c and hasattr(layer, "init_paged_carry"):
+                if self.paged_attention is not None:
+                    # push the server-level knob onto the layer: the
+                    # layer resolves it at trace time, so every program
+                    # family (prefill / decode / spec verify) routes its
+                    # paged reads through the same backend. The prior
+                    # knob is restored on close() — a server override
+                    # must not leak into a net another server serves
+                    # later.
+                    self._pa_prev[name] = layer.paged_attention
+                    layer.paged_attention = self.paged_attention
                 self._paged_names.append(name)
                 h = layer.n_heads
                 self._page_token_bytes += 2 * h * (
@@ -592,6 +614,17 @@ class GenerationServer:
         self._capacity = cap
         self._cap_tokens = cap
         self._np = cap // self._ps
+        # resolve the paged-attention backend ONCE against the real pool
+        # geometry: this is the program-cache tag (xla/pallas families
+        # must never share traces) and picks the decode dispatch family.
+        # Resolution is host config + static shapes — never traced data.
+        from deeplearning4j_tpu.nn.conf.layers.paged_attention import (
+            resolve_paged_backend)
+        first = self._layer_by_name[self._paged_names[0]]
+        self._pa = resolve_paged_backend(
+            first.paged_attention, page_size=self._ps,
+            head_dim=first.n_out // first.n_heads, n_pages=self._np,
+            quant=self._kv_quant)
 
     def _probe_draft(self):
         draft = self._draft
@@ -686,7 +719,18 @@ class GenerationServer:
         tokens past a request's ``max_tokens`` can hit the clamp — the
         host truncates those anyway — so admission needs NO look-ahead
         margin and ``steps_per_dispatch`` can exceed a request's
-        remaining budget safely."""
+        remaining budget safely.
+
+        Under the ``pallas`` paged-attention backend the dense gather
+        disappears entirely: each micro-step threads the pool + block
+        table straight through ``_paged_forward``, whose Pallas kernel
+        reads K/V pages in place (the whole point of the seam — the
+        gather cost at long contexts is what the kernel deletes).
+        Frozen rows swap their block-table row for the garbage page so
+        the clamped column write cannot clobber real KV at capacity-1;
+        their outputs are discarded by the same hold logic either way.
+        The two families are keyed apart in the program cache and are
+        bit-exact (tests/test_paged_attention.py pins it)."""
         import jax
         import jax.numpy as jnp
 
@@ -698,11 +742,62 @@ class GenerationServer:
         paged = tuple(self._paged_names)
         pos_only = tuple(self._pos_names)
         quant = self._kv_quant
-        key = ("gen_decode", self.slots, vocab, m_steps, self.kv_dtype)
+        pa = self._pa
+        key = ("gen_decode", self.slots, vocab, m_steps, self.kv_dtype,
+               pa)
 
         def build():
             fwd = lm_stream_forward(net)
             dtype = jnp.dtype(net.conf.dtype)
+
+            def paged_step(params, state, pool, bt, positions, last,
+                           active, temp, topk, base_keys, counts):
+                first = next(iter(paged))
+                ps = pool[first]["kpages"].shape[2]
+                cap = bt.shape[1] * ps
+
+                def body(cs, _):
+                    pool, pos, cur, cnt = cs
+                    # write-clamp: overshoot rows at capacity freeze,
+                    # and their WHOLE block-table row swaps to the
+                    # garbage page so the clamped column write lands
+                    # there instead of on real KV at capacity-1
+                    act = active & (pos < cap)
+                    posw = jnp.minimum(pos, cap - 1)
+                    bt_eff = jnp.where(act[:, None], bt, 0)
+                    carry = {}
+                    for vn in pos_only:
+                        carry[vn] = {"cache_pos": posw}
+                    for vn in paged:
+                        carry[vn] = dict(pool[vn])
+                        carry[vn]["block_table"] = bt_eff
+                        carry[vn]["cache_pos"] = posw
+                    x = jax.nn.one_hot(cur, vocab,
+                                       dtype=dtype)[:, None, :]
+                    out, nc = fwd(params, state, x, carry)
+                    pool = {vn: {k: nc[vn][k] for k in pool[vn]}
+                            for vn in paged}
+
+                    def _greedy(out0):
+                        return jnp.argmax(out0, axis=-1).astype(jnp.int32)
+
+                    def _sampled(out0):
+                        keys = jax.vmap(jax.random.fold_in)(base_keys,
+                                                            cnt)
+                        return sampled_next_token(
+                            out0, keys, temp, topk).astype(jnp.int32)
+
+                    nxt = jax.lax.cond(jnp.all(temp <= 0.0),
+                                       _greedy, _sampled, out[:, 0])
+                    nxt = jnp.where(act, nxt, cur).astype(cur.dtype)
+                    pos = jnp.where(act, pos + 1, pos)
+                    cnt = jnp.where(act, cnt + 1, cnt)
+                    return (pool, pos, nxt, cnt), nxt
+
+                (pool, _, _, _), seq = jax.lax.scan(
+                    body, (pool, positions, last, counts), None,
+                    length=m_steps)
+                return pool, seq.T                         # [S, M]
 
             def gather(pages, bt):
                 S, NP = bt.shape
@@ -806,7 +901,8 @@ class GenerationServer:
                     length=m_steps)
                 return pool, seq.T                         # [S, M]
 
-            return jax.jit(step, donate_argnums=(2,))
+            return jax.jit(paged_step if pa == "pallas" else step,
+                           donate_argnums=(2,))
 
         return net._get_output(key, build)
 
@@ -828,7 +924,8 @@ class GenerationServer:
         net, vocab = self.net, self.vocab
         paged = tuple(self._paged_names)
         pos_only = tuple(self._pos_names)
-        key = ("gen_prefill", self.slots, vocab, bucket, self.kv_dtype)
+        key = ("gen_prefill", self.slots, vocab, bucket, self.kv_dtype,
+               self._pa)
 
         def build():
             fwd = lm_stream_forward(net)
@@ -989,7 +1086,7 @@ class GenerationServer:
         # identity — a draft shared across servers never replays a
         # program traced against a different target
         key = ("gen_spec", id(net), self.slots, vocab, k_spec,
-               self.kv_dtype)
+               self.kv_dtype, self._pa)
 
         def build():
             fwd = lm_stream_forward(net)
@@ -2328,6 +2425,12 @@ class GenerationServer:
         for req in victims:
             self._fail(req, RuntimeError("GenerationServer closed with "
                                          "the request still in flight"))
+        # un-push the paged-attention override: layer config belongs to
+        # the net, and the next server over this net must see the knob
+        # it would have seen before this one existed
+        for name, prev in self._pa_prev.items():
+            self._layer_by_name[name].paged_attention = prev
+        self._pa_prev = {}
 
     # ------------------------------------------------------------- stats
     def stats(self) -> dict:

@@ -194,6 +194,13 @@ def pytest_configure(config):
         "stores, query coalescer parity, recall gates, hardened /knn "
         "HTTP tier — CPU-fast; runs in tier-1, deliberately NOT in the "
         "slow set)")
+    config.addinivalue_line(
+        "markers",
+        "pallas: Pallas-kernel parity tests (paged-attention helper seam "
+        "XLA-vs-kernel bit-exactness in interpret mode, backend "
+        "selection, backend-tagged program caches — CPU-fast; runs in "
+        "tier-1, deliberately NOT in the slow set; skips cleanly when "
+        "the installed jax cannot interpret Pallas TPU kernels on CPU)")
 
 
 @pytest.fixture(autouse=True)
@@ -212,7 +219,8 @@ def _lock_order_debug(request):
             or request.node.get_closest_marker("handoff")
             or request.node.get_closest_marker("disagg")
             or request.node.get_closest_marker("runtime")
-            or request.node.get_closest_marker("knn")):
+            or request.node.get_closest_marker("knn")
+            or request.node.get_closest_marker("pallas")):
         yield
         return
     from deeplearning4j_tpu.analysis import instrument

@@ -67,3 +67,16 @@ def summarize(state, xs):
     # NEGATIVE jax-host-sync-in-hot-loop: not a hot-loop function name —
     # a one-off fetch at epoch end is fine
     return float(state.loss) + np.asarray(xs).sum()
+
+
+def make_paged_step(backend):
+    # NEGATIVE jax-retrace-hazard: the helper-seam backend is HOST
+    # config captured by the closure — resolved once at build time, one
+    # program per backend family, never a branch on traced data
+    @jax.jit
+    def step(x):
+        if backend == "pallas":
+            return x * 2.0  # pretend: the accelerated kernel
+        return x + 1.0      # pretend: the stock fallback
+
+    return step

@@ -72,3 +72,13 @@ def _decode_once(state, xs):
     b = float(state.loss)         # POSITIVE jax-host-sync-in-hot-loop
     c = np.asarray(xs)            # POSITIVE jax-host-sync-in-hot-loop
     return a + b + c.sum()
+
+
+@jax.jit
+def helper_switch_on_traced(x, occupancy):
+    # POSITIVE jax-retrace-hazard: a helper-seam backend chosen on a
+    # TRACED value — every occupancy retraces a fresh program, and the
+    # two "backends" silently share one program-cache key
+    if occupancy > 4:
+        return x * 2.0  # pretend: the accelerated kernel
+    return x + 1.0      # pretend: the stock fallback

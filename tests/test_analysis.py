@@ -93,6 +93,10 @@ def test_jax_rules_positives():
     assert ("jax-retrace-hazard", "retrace_if:if:threshold") in got
     assert ("jax-retrace-hazard", "retrace_while:while:n") in got
     assert ("jax-retrace-hazard", "retrace_range:range:n") in got
+    # helper-seam hazard: accelerated-vs-stock backend chosen on a
+    # traced value (the PagedAttentionHelper anti-pattern)
+    assert ("jax-retrace-hazard",
+            "helper_switch_on_traced:if:occupancy") in got
     # randomness baked in at trace time
     assert ("jax-untraced-randomness", "baked_noise:np.random.normal") in got
     assert ("jax-untraced-randomness", "baked_choice:random.random") in got
