@@ -1455,6 +1455,141 @@ def bench_generate_longtail(slots: int = 8, vocab: int = 256,
     }
 
 
+def bench_generate_mesh(n_requests: int = 24, vocab: int = 256,
+                        d_model: int = 256, n_blocks: int = 2,
+                        n_heads: int = 8, slots: int = 12,
+                        pages: int = 128, page_size: int = 16,
+                        chip_budget_mb: float = 6.0, repeats: int = 2):
+    """Tensor-parallel mesh-sharded paged decode: serve a TransformerLM
+    whose page pool does NOT fit one chip's KV budget. The pool here is
+    ~8 MiB against a {chip_budget_mb} MiB per-chip envelope — single-
+    chip serving is over budget, and head-axis sharding is what brings
+    the per-chip residency back inside it (pool/tp: under at tp=2, half
+    the envelope at tp=4). Both facts are asserted from the server's
+    OWN page accounting, not recomputed on faith.
+
+    Runs the same mixed greedy workload at tp=1, tp=2 and tp=4 over the
+    forced 8-virtual-device CPU mesh (standalone:
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8 python
+    bench.py generate_mesh`` — main() sets the flag for this sub-bench
+    when run standalone) and reports tokens/s per tp plus per-chip
+    tokens/s. Every tp>1 completion is checked BIT-identical to the
+    tp=1 server's — the zero-drift sharding contract is part of the
+    bench, not a separate test. On CPU the \"chips\" share one socket,
+    so the asserted scaling is the CAPACITY scaling (per-chip bytes =
+    pool/tp, exact); wall-clock scaling is a real-mesh property and the
+    reported ratios are informational with only a collapse floor
+    asserted."""
+    import os
+
+    import jax
+
+    flag = "--xla_force_host_platform_device_count=8"
+    if flag not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
+    if len(jax.devices()) < 4:
+        raise RuntimeError(
+            f"generate_mesh needs >= 4 devices, found "
+            f"{len(jax.devices())} — run standalone so XLA_FLAGS="
+            f"{flag} lands before the backend initializes, or run on "
+            "a real mesh")
+
+    from deeplearning4j_tpu.models import TransformerLM
+    from deeplearning4j_tpu.parallel.generation import GenerationServer
+
+    rs = np.random.RandomState(13)
+    shapes = [(6, 40), (14, 48), (6, 48), (14, 40)]  # (plen, max_tokens)
+    reqs = [(rs.randint(0, vocab, shapes[i % 4][0]), shapes[i % 4][1])
+            for i in range(n_requests)]
+    n_tokens = sum(steps for _, steps in reqs)
+    net = TransformerLM(num_labels=vocab, max_length=64, d_model=d_model,
+                        n_heads=n_heads, n_blocks=n_blocks, seed=0).init()
+    for v in net.conf.vertices.values():
+        lyr = getattr(v, "layer", None)
+        if lyr is not None and hasattr(lyr, "max_cache"):
+            lyr.max_cache = 64
+
+    budget = chip_budget_mb * 2**20
+
+    def run_tp(tp):
+        srv = GenerationServer(net, vocab, slots=slots, pages=pages,
+                               page_size=page_size, steps_per_dispatch=8,
+                               max_pending=max(64, n_requests), tp=tp)
+        best = float("inf")
+        try:
+            st = srv.stats()["pages"]
+            pool_bytes = (st["pages_total"] * st["page_size"]
+                          * st["bytes_per_token"])
+            for f in [srv.submit(p, 2) for p, _ in reqs[:2]]:  # warm
+                f.result(timeout=SUB_BENCH_TIMEOUT_S)
+            outs = None
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                futs = [srv.submit(p, steps) for p, steps in reqs]
+                outs = [f.result(timeout=SUB_BENCH_TIMEOUT_S)
+                        for f in futs]
+                best = min(best, time.perf_counter() - t0)
+        finally:
+            srv.close()
+        return pool_bytes, outs, n_tokens / best
+
+    pool_bytes, base_outs, tps = {}, None, {}
+    for tp in (1, 2, 4):
+        pool_b, outs, tok_s = run_tp(tp)
+        pool_bytes[tp] = pool_b
+        tps[tp] = tok_s
+        if base_outs is None:
+            base_outs = outs
+        else:
+            bad = sum(1 for got, ref in zip(outs, base_outs)
+                      if not np.array_equal(got, ref))
+            if bad:
+                raise RuntimeError(
+                    f"{bad}/{n_requests} tp={tp} completions differ "
+                    "from the tp=1 server's — head-axis sharding must "
+                    "never change an output bit")
+
+    # capacity scaling: the model is over budget single-chip, inside it
+    # sharded — measured from the server's own page accounting
+    if pool_bytes[1] <= budget:
+        raise RuntimeError(
+            f"pool {pool_bytes[1] / 2**20:.1f} MiB fits the "
+            f"{chip_budget_mb} MiB chip budget single-chip — the bench "
+            "must serve a model one chip CANNOT hold; grow pages/"
+            "d_model or shrink the budget")
+    for tp in (2, 4):
+        per_chip = pool_bytes[tp] / tp
+        if per_chip > budget:
+            raise RuntimeError(
+                f"tp={tp} leaves {per_chip / 2**20:.1f} MiB per chip — "
+                f"still over the {chip_budget_mb} MiB budget")
+    for tp in (2, 4):  # collapse floor only: real scaling needs a mesh
+        if tps[tp] < 0.05 * tps[1]:
+            raise RuntimeError(
+                f"tp={tp} decode collapsed to {tps[tp]:.0f} tokens/s "
+                f"vs {tps[1]:.0f} at tp=1 — sharding overhead ate the "
+                "dispatch, not just the collectives")
+
+    return {
+        "generate_mesh_tp1_tokens_s": _sane(
+            "generate_mesh_tp1_tokens_s", tps[1]),
+        "generate_mesh_tp2_tokens_s": _sane(
+            "generate_mesh_tp2_tokens_s", tps[2]),
+        "generate_mesh_tp4_tokens_s": _sane(
+            "generate_mesh_tp4_tokens_s", tps[4]),
+        "generate_mesh_tp2_tokens_s_per_chip": _sane(
+            "generate_mesh_tp2_tokens_s_per_chip", tps[2] / 2),
+        "generate_mesh_tp4_tokens_s_per_chip": _sane(
+            "generate_mesh_tp4_tokens_s_per_chip", tps[4] / 4),
+        "generate_mesh_tp2_scaling": tps[2] / tps[1],
+        "generate_mesh_tp4_scaling": tps[4] / tps[1],
+        "generate_mesh_pool_mb": pool_bytes[1] / 2**20,
+        "generate_mesh_chip_budget_mb": float(chip_budget_mb),
+        "generate_mesh_tp4_per_chip_mb": pool_bytes[4] / 4 / 2**20,
+    }
+
+
 def bench_quant_serve(slots: int = 16, vocab: int = 256,
                       d_model: int = 256, n_blocks: int = 2,
                       repeats: int = 2):
@@ -2284,6 +2419,11 @@ SANITY_CEILING = {
     "generate_serve_tokens_s": 1e9,
     "generate_serve_serial_tokens_s": 1e9,
     "generate_longtail_tokens_s": 1e9,
+    "generate_mesh_tp1_tokens_s": 1e9,
+    "generate_mesh_tp2_tokens_s": 1e9,
+    "generate_mesh_tp4_tokens_s": 1e9,
+    "generate_mesh_tp2_tokens_s_per_chip": 1e9,
+    "generate_mesh_tp4_tokens_s_per_chip": 1e9,
     "quant_serve_tokens_s": 1e9,
     "quant_serve_f32_tokens_s": 1e9,
     "quant_infer_req_s": 1e8,
@@ -2402,6 +2542,16 @@ METRIC_UNIT = {
     "generate_longtail_prefix_hits": "hits",
     "generate_longtail_prefix_tokens_reused": "tokens",
     "generate_longtail_cow_copies": "copies",
+    "generate_mesh_tp1_tokens_s": "tokens/s",
+    "generate_mesh_tp2_tokens_s": "tokens/s",
+    "generate_mesh_tp4_tokens_s": "tokens/s",
+    "generate_mesh_tp2_tokens_s_per_chip": "tokens/s/chip",
+    "generate_mesh_tp4_tokens_s_per_chip": "tokens/s/chip",
+    "generate_mesh_tp2_scaling": "x",
+    "generate_mesh_tp4_scaling": "x",
+    "generate_mesh_pool_mb": "MiB",
+    "generate_mesh_chip_budget_mb": "MiB",
+    "generate_mesh_tp4_per_chip_mb": "MiB",
     "quant_serve_kv_capacity_x": "x",
     "quant_serve_tokens_s": "tokens/s",
     "quant_serve_f32_tokens_s": "tokens/s",
@@ -2667,10 +2817,21 @@ def main():
              "guard_overhead", "metrics_overhead", "inference_serve",
              "serve_chaos", "serve_fleet", "serve_handoff", "serve_disagg",
              "serve_soak", "serve_restart",
-             "generate_serve", "generate_longtail", "quant_serve",
-             "quant_infer", "knn_serve")
+             "generate_serve", "generate_longtail", "generate_mesh",
+             "quant_serve", "quant_infer", "knn_serve")
     if which not in valid:
         sys.exit(f"Unknown model '{which}'; choose one of {valid}")
+    # the mesh bench needs virtual devices BEFORE the backend
+    # initializes: standalone, plant the flag here (first thing, ahead
+    # of any jax-importing package import); under "all" the bench
+    # checks the device count itself and fails loudly if the backend
+    # came up single-device
+    if which == "generate_mesh":
+        import os as _os
+        _flag = "--xla_force_host_platform_device_count=8"
+        if _flag not in _os.environ.get("XLA_FLAGS", ""):
+            _os.environ["XLA_FLAGS"] = (
+                _os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()
     # persistent XLA compile cache: repeated bench runs skip the
     # tens-of-seconds remote cold compile per model (13.7 s -> 2.4 s
     # measured for a LeNet cold start). The repo-local default applies
@@ -2741,6 +2902,8 @@ def main():
         _sub_metric(extras, "generate_serve", bench_generate_serve)
     if which in ("all", "generate_longtail"):
         _sub_metric(extras, "generate_longtail", bench_generate_longtail)
+    if which in ("all", "generate_mesh"):
+        _sub_metric(extras, "generate_mesh", bench_generate_mesh)
         headline and headline.sample("post-generate-serve")
     if which in ("all", "quant_serve"):
         _sub_metric(extras, "quant_serve", bench_quant_serve)

@@ -88,6 +88,19 @@ class SelfAttentionLayer(BaseLayer):
     # Resolution is trace-time static; serving program caches key on it.
     paged_attention: str = "auto"
 
+    #: Tensor-parallel mesh for the paged decode path. Deliberately a
+    #: plain CLASS attribute (no dataclass annotation): a live
+    #: ``jax.sharding.Mesh`` is host runtime state, not layer config, so
+    #: it must never serialize with the net. ``GenerationServer(mesh=)``
+    #: pushes it per-instance and restores the prior value on close()
+    #: (the same restore-on-close discipline as ``paged_attention``).
+    #: When set, ``_paged_forward`` splits the write-scatter + attend
+    #: head-parallel over the mesh's ``model`` axis; projections and
+    #: page routing stay replicated, so outputs are bit-identical to the
+    #: single-chip path at every tp (the only collective is an exact
+    #: all-gather of disjoint per-head contexts before Wo).
+    paged_mesh = None
+
     INPUT_KIND = "rnn"
     DEFAULT_ACTIVATION = "identity"
     #: projection weights eligible for int8 per-output-channel
@@ -360,6 +373,18 @@ class SelfAttentionLayer(BaseLayer):
             logits = jnp.where(key_valid[:, None, None, :], logits, NEG_INF)
         o = jnp.einsum("bhtk,bhkd->bhtd",
                        jax.nn.softmax(logits, axis=-1), vd)
+        if self.paged_mesh is not None:
+            # tensor-parallel decode gathers the paged pool into dense
+            # views sharded on the head axis; GSPMD keeps every op so
+            # far per-head (no cross-shard reduction). Pin the contexts
+            # replicated HERE — an exact all-gather of disjoint head
+            # slices — so the head-merging reshape below can never turn
+            # the Wo contraction into a partial-sum all-reduce (float
+            # reordering would break tp-vs-single-chip bit-exactness).
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            o = jax.lax.with_sharding_constraint(
+                o, NamedSharding(self.paged_mesh, PartitionSpec()))
         o = o.transpose(0, 2, 1, 3).reshape(B, T, self.n_out)
         out = self._proj(params, o, "Wo", "bto,op->btp") + params["b"]
         if mask is not None:
@@ -446,22 +471,30 @@ class SelfAttentionLayer(BaseLayer):
             # never dirty real pages and a row needs page backing for
             # its true tokens only
             pg = jnp.where(mask.astype(bool), pg, 0)
-        kp = kp.at[pg, :, off, :].set(k.astype(kp.dtype).transpose(0, 2, 1, 3))
-        vp = vp.at[pg, :, off, :].set(v.astype(vp.dtype).transpose(0, 2, 1, 3))
-        if quant:
-            ksp = ksp.at[pg, :, off].set(ksc.transpose(0, 2, 1))
-            vsp = vsp.at[pg, :, off].set(vsc.transpose(0, 2, 1))
-        # read side: attend over the resident pages through the selected
-        # helper backend. Resolution is trace-time static (the knob is
-        # host config, the geometry is shapes), so each backend family
-        # traces its own program — never a retrace hazard.
-        from deeplearning4j_tpu.nn.conf.layers import paged_attention as ppa
+        if self.paged_mesh is not None:
+            kp, vp, ksp, vsp, o = self._sharded_write_attend(
+                q, k, v, ksc, vsc, kp, vp, ksp, vsp, bt, pos, pg, off,
+                mask, quant, ps, NP)
+        else:
+            kp = kp.at[pg, :, off, :].set(
+                k.astype(kp.dtype).transpose(0, 2, 1, 3))
+            vp = vp.at[pg, :, off, :].set(
+                v.astype(vp.dtype).transpose(0, 2, 1, 3))
+            if quant:
+                ksp = ksp.at[pg, :, off].set(ksc.transpose(0, 2, 1))
+                vsp = vsp.at[pg, :, off].set(vsc.transpose(0, 2, 1))
+            # read side: attend over the resident pages through the
+            # selected helper backend. Resolution is trace-time static
+            # (the knob is host config, the geometry is shapes), so each
+            # backend family traces its own program — never a retrace
+            # hazard.
+            from deeplearning4j_tpu.nn.conf.layers import paged_attention as ppa
 
-        backend = ppa.resolve_paged_backend(
-            self.paged_attention, page_size=ps,
-            head_dim=self.n_out // self.n_heads, n_pages=NP, quant=quant)
-        o = ppa.paged_attend(backend, q, kp, vp, bt, pos, mask=mask,
-                             kscales=ksp, vscales=vsp)
+            backend = ppa.resolve_paged_backend(
+                self.paged_attention, page_size=ps,
+                head_dim=self.n_out // self.n_heads, n_pages=NP, quant=quant)
+            o = ppa.paged_attend(backend, q, kp, vp, bt, pos, mask=mask,
+                                 kscales=ksp, vscales=vsp)
         o = o.transpose(0, 2, 1, 3).reshape(B, T, self.n_out)
         out = self._proj(params, o, "Wo", "bto,op->btp") + params["b"]
         if mask is not None:
@@ -474,6 +507,77 @@ class SelfAttentionLayer(BaseLayer):
             new_state["vscales"] = vsp
         new_state["cache_pos"] = pos + T
         return self.act()(out), new_state
+
+    def _sharded_write_attend(self, q, k, v, ksc, vsc, kp, vp, ksp, vsp,
+                              bt, pos, pg, off, mask, quant, ps, NP):
+        """Head-parallel write + attend over ``self.paged_mesh``.
+
+        The math is the single-chip ``_paged_forward`` body verbatim,
+        run per-shard on the ``H/tp`` local head slice: q/k/v chunks and
+        the pool leaves split on their head axis, the block table / page
+        routing replicated (every shard scatters into the SAME pages of
+        its own head slice). Attention contexts are independent per
+        head, so the shard outputs are disjoint and the head-axis
+        all-gather of ``o`` (forced by the caller's replication
+        constraint before Wo) is exact concatenation — no reduction, no
+        float reordering — which is what makes tp>1 outputs bit-exact
+        against tp=1. Both helper backends serve the local view
+        unchanged: the XLA gather sees an ``[P, H/tp, ps, d]`` pool, the
+        Pallas kernel a ``(B, H/tp, NP)`` grid.
+        """
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from deeplearning4j_tpu.nn.conf.layers import paged_attention as ppa
+        from deeplearning4j_tpu.parallel.mesh import (MODEL_AXIS,
+                                                      shard_map_compat)
+
+        mesh = self.paged_mesh
+        head4 = P(None, MODEL_AXIS, None, None)  # [B,H,T,d] / [P,H,ps,d]
+        head3 = P(None, MODEL_AXIS, None)        # [B,H,T]   / [P,H,ps]
+        head_dim = self.n_out // self.n_heads
+        has_mask = mask is not None
+
+        def local(q, k, v, kp, vp, bt, pos, pg, off, ksc, vsc, ksp, vsp,
+                  mask):
+            kp = kp.at[pg, :, off, :].set(
+                k.astype(kp.dtype).transpose(0, 2, 1, 3))
+            vp = vp.at[pg, :, off, :].set(
+                v.astype(vp.dtype).transpose(0, 2, 1, 3))
+            if quant:
+                ksp = ksp.at[pg, :, off].set(ksc.transpose(0, 2, 1))
+                vsp = vsp.at[pg, :, off].set(vsc.transpose(0, 2, 1))
+            backend = ppa.resolve_paged_backend(
+                self.paged_attention, page_size=ps, head_dim=head_dim,
+                n_pages=NP, quant=quant)
+            o = ppa.paged_attend(backend, q, kp, vp, bt, pos,
+                                 mask=mask if has_mask else None,
+                                 kscales=ksp, vscales=vsp)
+            out = [kp, vp, o]
+            if quant:
+                out += [ksp, vsp]
+            return tuple(out)
+
+        # None operands have no leaves, so any placeholder spec works;
+        # the quant/mask STRUCTURE is already part of the jit cache key
+        in_specs = (head4, head4, head4, head4, head4, P(), P(), P(), P(),
+                    head3 if quant else P(), head3 if quant else P(),
+                    head3 if quant else P(), head3 if quant else P(),
+                    P())
+        out_specs = (head4, head4, head4) + ((head3, head3) if quant
+                                             else ())
+        fn = shard_map_compat(local, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check=False)
+        res = fn(q, k, v, kp, vp, bt, pos, pg, off, ksc, vsc, ksp, vsp,
+                 mask if has_mask else None)
+        kp, vp, o = res[0], res[1], res[2]
+        if quant:
+            ksp, vsp = res[3], res[4]
+        # replicate the per-head contexts before the (replicated) Wo
+        # projection: an exact all-gather — each shard contributed a
+        # disjoint head slice, so no arithmetic happens in the collective
+        o = jax.lax.with_sharding_constraint(
+            o, NamedSharding(mesh, P()))
+        return kp, vp, ksp, vsp, o
 
 
 @register_serializable

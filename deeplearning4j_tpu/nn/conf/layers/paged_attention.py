@@ -29,6 +29,17 @@ write side — scattering the fresh chunk through the block table, including
 the garbage-page-0 routing for masked columns — stays shared in
 ``_paged_forward`` so COW/prefix-sharing/snapshot semantics are identical
 under every backend.
+
+Tensor-parallel (mesh-sharded) serving hands BOTH backends a *local head
+shard* of the pool instead of the full pool: ``SelfAttentionLayer`` with
+``paged_mesh`` set runs the write + attend inside ``shard_map``, so
+``attend`` sees ``kp``/``vp`` as ``[P, H/tp, ps, d]`` (scale planes
+``[P, H/tp, ps]``) and ``q`` as ``[B, H/tp, T, d]`` with the block table
+and ``cache_pos`` replicated. Neither backend needs to know: every shape
+here is taken from the operands, so the XLA gather runs over the local
+pool shard and the Pallas grid becomes ``(B, H/tp, NP)`` — the natural
+head-axis cut of its ``(B, H, NP)`` grid. Head contexts are independent,
+so per-shard outputs concatenate exactly (bit-exact at every tp).
 """
 
 from __future__ import annotations

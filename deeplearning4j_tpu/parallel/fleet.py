@@ -97,6 +97,37 @@ RETIRED = "retired"
 _EWMA_FLOOR_MS = 0.5  # score floor so a fresh replica isn't infinitely hot
 
 
+def device_groups(n_groups: int, tp: int, devices=None) -> list:
+    """Partition the device pool into ``n_groups`` disjoint lists of
+    ``tp`` devices — one tensor-parallel replica group per fleet
+    replica. The factory idiom::
+
+        groups = device_groups(2, tp=4)
+        fleet = ReplicaFleet(lambda rid: GenerationServer(
+            net, vocab, mesh=model_mesh(tp, devices=groups[rid % 2])),
+            replicas=2)
+
+    Groups are disjoint by construction so two replicas never contend
+    for a chip; validation is loud (``MeshGeometryError``) because a
+    short group would silently shrink the page budget the replica
+    admits against."""
+    import jax
+
+    from deeplearning4j_tpu.parallel.mesh import MeshGeometryError
+
+    if devices is None:
+        devices = jax.devices()
+    if n_groups < 1 or tp < 1:
+        raise MeshGeometryError(
+            f"need n_groups >= 1 and tp >= 1, got {n_groups}x{tp}")
+    need = n_groups * tp
+    if need > len(devices):
+        raise MeshGeometryError(
+            f"{n_groups} replica groups x tp={tp} needs {need} devices, "
+            f"have {len(devices)}")
+    return [list(devices[g * tp:(g + 1) * tp]) for g in range(n_groups)]
+
+
 class _Replica:
     """Mutable per-replica record. No lock of its own — every field is
     read and written only under the owning fleet's ``_cond`` (``server``,

@@ -232,6 +232,14 @@ class GenerationServer:
     _LOOP_OWNED = ("_slot_req",)
     _LOOP_LOCK = "_cond"
 
+    #: Class-wide trace lock (rank 28, see analysis/instrument.py):
+    #: fleet replica groups share ONE net object but carry per-replica
+    #: meshes, so the layer-knob push (paged_mesh / paged_attention) and
+    #: the trace that bakes it into a program must be atomic against a
+    #: sibling server tracing concurrently. Acquired with no other lock
+    #: held; a build never touches ``_cond``.
+    _trace_lock = threading.Lock()
+
     def __init__(self, net, vocab: int, *, slots: int = 8,
                  eos_id: Optional[int] = None,
                  max_pending: int = 64,
@@ -244,6 +252,8 @@ class GenerationServer:
                  steps_per_dispatch: int = 4,
                  kv_dtype: Optional[str] = None,
                  paged_attention: Optional[str] = None,
+                 mesh=None,
+                 tp: Optional[int] = None,
                  draft_net=None,
                  spec_k: int = 4,
                  snapshot_every: int = 0,
@@ -318,6 +328,35 @@ class GenerationServer:
         self.retry = retry if retry is not None else RetryPolicy()
         self.breaker = breaker if breaker is not None else CircuitBreaker()
         self._chaos = chaos
+
+        # tensor-parallel decode: the paged KV pool shards head-parallel
+        # over the mesh's "model" axis ([P, H/tp, ps, d] per chip) while
+        # weights, activations and the host-owned block table stay
+        # replicated — the only collective in the whole decode step is
+        # an exact all-gather of disjoint per-head contexts, so outputs
+        # are bit-identical to the single-chip path at every tp.
+        # ``tp=`` is the convenience spelling (builds a model_mesh over
+        # the first tp devices); an explicit ``mesh=`` wins and lets a
+        # fleet pin each replica group to its own device subset.
+        # tp in (None, 1) keeps the single-chip path byte-for-byte.
+        from deeplearning4j_tpu.parallel.mesh import (MODEL_AXIS,
+                                                      MeshGeometryError,
+                                                      model_mesh)
+        if mesh is None and tp is not None and int(tp) != 1:
+            mesh = model_mesh(int(tp))
+        if mesh is not None:
+            if MODEL_AXIS not in mesh.axis_names:
+                raise MeshGeometryError(
+                    f"GenerationServer mesh needs a {MODEL_AXIS!r} axis "
+                    f"to shard KV heads over, got axes {mesh.axis_names}")
+            if tp is not None and int(tp) != mesh.shape[MODEL_AXIS]:
+                raise MeshGeometryError(
+                    f"tp={tp} disagrees with the mesh's "
+                    f"{mesh.shape[MODEL_AXIS]}-way {MODEL_AXIS!r} axis")
+        self._mesh = None if (mesh is None
+                              or mesh.shape[MODEL_AXIS] == 1) else mesh
+        self._tp = 1 if self._mesh is None \
+            else int(self._mesh.shape[MODEL_AXIS])
 
         self._ps = int(page_size)
         # prefill rounds advance at most this many (page-aligned) tokens
@@ -564,6 +603,7 @@ class GenerationServer:
         self._pos_names: list = []
         self._layer_by_name: dict = {}
         self._pa_prev: dict = {}
+        self._mesh_prev: dict = {}
         self._page_token_bytes = 0
         # admission accounting must track the CACHE dtype, not the conf
         # dtype: int8 pages store 1-byte values plus one f32 scale per
@@ -593,6 +633,20 @@ class GenerationServer:
                     layer.paged_attention = self.paged_attention
                 self._paged_names.append(name)
                 h = layer.n_heads
+                if self._mesh is not None and h % self._tp:
+                    from deeplearning4j_tpu.parallel.mesh import (
+                        MeshGeometryError)
+                    raise MeshGeometryError(
+                        f"layer {name!r} has {h} heads, not divisible by "
+                        f"tp={self._tp}: the head-parallel pool shard "
+                        "[pages, H/tp, page_size, d] would be ragged")
+                # record the pre-server mesh knob but do NOT push it
+                # here: the push is BUILD-scoped (_get_program sets it
+                # under the trace lock and restores it after the trace),
+                # so sibling servers with different meshes on this net
+                # never see each other's Mesh on the layer. close()
+                # restores defensively in case a build hard-crashed.
+                self._mesh_prev[name] = layer.paged_mesh
                 self._page_token_bytes += 2 * h * (
                     (layer.n_out // h) * kv_itemsize + scale_bytes)
             elif "cache_pos" in c and "kcache" not in c:
@@ -678,7 +732,74 @@ class GenerationServer:
                 f"pool: {self._page_bytes} bytes/page expected from the "
                 f"conf, {self._page_bytes_actual} allocated "
                 f"(kv_dtype={self.kv_dtype!r})")
-        return jax.device_put(pool)
+        return self._shard_pool(pool)
+
+    def _shard_pool(self, pool):
+        """Home the page pool on device: a plain ``device_put`` single-
+        chip, or head-axis NamedSharding placement over the tensor-
+        parallel mesh — 4-D K/V leaves ``[P, H, ps, d]`` and 3-D int8
+        scale planes ``[P, H, ps]`` both split on axis 1, so each chip
+        holds a ``[P, H/tp, ps, d]`` slice and the per-chip page budget
+        is 1/tp of the single-chip pool. Placement only — on the
+        graftcheck hot list, so no host syncs in here."""
+        import jax
+
+        if self._mesh is None:
+            return jax.device_put(pool)
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from deeplearning4j_tpu.parallel.mesh import MODEL_AXIS
+
+        head4 = NamedSharding(self._mesh, P(None, MODEL_AXIS, None, None))
+        head3 = NamedSharding(self._mesh, P(None, MODEL_AXIS, None))
+
+        def put(leaf):
+            return jax.device_put(leaf,
+                                  head4 if leaf.ndim == 4 else head3)
+
+        return jax.tree_util.tree_map(put, pool)
+
+    def _reshard_snapshot(self, payload):
+        """Adopt-side reshard: place a snapshot's canonical host-layout
+        page payload (leaves ``[NP, H, ps, d]`` / ``[NP, H, ps]``) into
+        this server's pool sharding before the donated store dispatch,
+        so a snapshot exported at any tp scatters straight into a pool
+        sharded at THIS server's tp — each chip uploads only its own
+        head slice. Single-chip servers pass the payload through
+        untouched (the store program's jit places it). On the graftcheck
+        hot list: placement only, no host syncs."""
+        if self._mesh is None:
+            return payload
+        return self._shard_pool(payload)
+
+    def _get_program(self, cache_net, key, build):
+        """Compile-or-fetch a serving program with the layer knobs
+        re-pushed under the class-wide trace lock: the mesh (and the
+        paged-attention backend) are baked into the traced program, so
+        the push and the trace must be atomic against sibling servers
+        sharing this net. Program keys carry the mesh, so per-replica
+        families never share traces; cache hits skip the lock
+        entirely."""
+        def locked_build():
+            with GenerationServer._trace_lock:
+                saved = {}
+                for name in self._paged_names:
+                    layer = self._layer_by_name[name]
+                    saved[name] = layer.paged_mesh
+                    layer.paged_mesh = self._mesh
+                    if self.paged_attention is not None:
+                        layer.paged_attention = self.paged_attention
+                try:
+                    return build()
+                finally:
+                    # build-scoped: the Mesh never outlives the trace,
+                    # so the net's layers read as single-chip config
+                    # between builds (reference scans, sibling probes)
+                    for name, prev in saved.items():
+                        self._layer_by_name[name].paged_mesh = prev
+
+        return cache_net._get_output(key, locked_build)
 
     def _fresh_draft_pool(self):
         """Dense [S, H, cap, d] slot caches for the draft model (the
@@ -744,7 +865,7 @@ class GenerationServer:
         quant = self._kv_quant
         pa = self._pa
         key = ("gen_decode", self.slots, vocab, m_steps, self.kv_dtype,
-               pa)
+               self._mesh, pa)
 
         def build():
             fwd = lm_stream_forward(net)
@@ -904,7 +1025,7 @@ class GenerationServer:
             return jax.jit(paged_step if pa == "pallas" else step,
                            donate_argnums=(2,))
 
-        return net._get_output(key, build)
+        return self._get_program(net, key, build)
 
     def _prefill_program(self, bucket: int):
         """Batched suffix prefill for one page-aligned bucket: every
@@ -925,7 +1046,7 @@ class GenerationServer:
         paged = tuple(self._paged_names)
         pos_only = tuple(self._pos_names)
         key = ("gen_prefill", self.slots, vocab, bucket, self.kv_dtype,
-               self._pa)
+               self._mesh, self._pa)
 
         def build():
             fwd = lm_stream_forward(net)
@@ -957,7 +1078,7 @@ class GenerationServer:
 
             return jax.jit(prefill, donate_argnums=(2,))
 
-        return net._get_output(key, build)
+        return self._get_program(net, key, build)
 
     def _page_copy_program(self):
         """Copy-on-write: duplicate one pool page (all layers) into a
@@ -965,7 +1086,7 @@ class GenerationServer:
         import jax
 
         paged = tuple(self._paged_names)
-        key = ("gen_page_copy",)
+        key = ("gen_page_copy", self._mesh)
 
         def build():
             def copy(pool, src, dst):
@@ -977,7 +1098,7 @@ class GenerationServer:
 
             return jax.jit(copy, donate_argnums=(0,))
 
-        return self.net._get_output(key, build)
+        return self._get_program(self.net, key, build)
 
     def _page_fetch_program(self):
         """Snapshot export: gather a block-table-width stack of pool
@@ -987,7 +1108,7 @@ class GenerationServer:
         import jax
 
         paged = tuple(self._paged_names)
-        key = ("gen_page_fetch",)
+        key = ("gen_page_fetch", self._mesh)
 
         def build():
             def fetch(pool, idx):
@@ -996,7 +1117,7 @@ class GenerationServer:
 
             return jax.jit(fetch)
 
-        return self.net._get_output(key, build)
+        return self._get_program(self.net, key, build)
 
     def _page_store_program(self):
         """Snapshot adopt: scatter a block-table-width stack of page
@@ -1008,7 +1129,7 @@ class GenerationServer:
         import jax
 
         paged = tuple(self._paged_names)
-        key = ("gen_page_store",)
+        key = ("gen_page_store", self._mesh)
 
         def build():
             def store(pool, dst, data):
@@ -1018,7 +1139,7 @@ class GenerationServer:
 
             return jax.jit(store, donate_argnums=(0,))
 
-        return self.net._get_output(key, build)
+        return self._get_program(self.net, key, build)
 
     def _draft_prefill_program(self, bucket: int):
         """Draft-side prefill for one pow2 token bucket: consume the full
@@ -1086,7 +1207,7 @@ class GenerationServer:
         # identity — a draft shared across servers never replays a
         # program traced against a different target
         key = ("gen_spec", id(net), self.slots, vocab, k_spec,
-               self.kv_dtype, self._pa)
+               self.kv_dtype, self._mesh, self._pa)
 
         def build():
             fwd = lm_stream_forward(net)
@@ -1152,7 +1273,7 @@ class GenerationServer:
 
             return jax.jit(spec, donate_argnums=(4, 5))
 
-        return draft._get_output(key, build)
+        return self._get_program(draft, key, build)
 
     # ------------------------------------------------------------- submit
     def submit(self, prompt_ids, max_tokens: int, *,
@@ -1274,6 +1395,8 @@ class GenerationServer:
                 t0 = time.monotonic()
                 if self._draft is not None:
                     self._spec_decode_once()
+                elif self._mesh is not None:
+                    self._mesh_decode_once()
                 else:
                     self._decode_once()
                 self._m_busy_s.inc(time.monotonic() - t0)
@@ -1825,6 +1948,19 @@ class GenerationServer:
         self._m_decode_steps.inc()
         self._m_tokens.inc(ntok)
 
+    def _mesh_decode_once(self):
+        """Mesh-path decode tick: ONE mesh-wide compiled dispatch
+        advances every active slot ``steps_per_dispatch`` micro-steps
+        over the head-sharded pool. The dispatch body is shared with
+        ``_decode_once`` on purpose — the sharding is carried entirely
+        by the pool's NamedSharding placement plus the layers' pushed
+        ``paged_mesh`` (both baked into the mesh-keyed program), so one
+        body means the mesh path can never drift from the bit-exact
+        single-chip math, and occupancy churn stays data-only (zero
+        retrace). On the graftcheck hot list like its single-chip twin:
+        the one host sync is the batched ``[S, M]`` token fetch."""
+        self._decode_once()
+
     def _spec_decode_once(self):
         import jax
 
@@ -1982,13 +2118,19 @@ class GenerationServer:
         idx = np.zeros(self._np, np.int32)  # pad rows fetch page 0
         idx[:n] = sp[:n]
         prog = self._page_fetch_program()
+        # device_get of the (possibly head-sharded) gather assembles the
+        # CANONICAL host layout — full [NP, H, ps, d] stacks — so the
+        # wire payload is tp-independent and any-tp adopters re-shard
+        # locally (_reshard_snapshot); the header records this server's
+        # shard count for diagnostics only
         fetched = jax.device_get(prog(self._pool, idx))
         return pack_snapshot(
             req=req, pos=pos, count=self._counts[slot],
             last=self._last[slot], key=self._keys[slot].copy(),
             kv_dtype=self.kv_dtype, page_size=self._ps,
             page_token_bytes=self._page_token_bytes,
-            page_digests=digests, fetched=fetched, n_pages=n)
+            page_digests=digests, fetched=fetched, n_pages=n,
+            shards=self._tp, head_layout="canonical")
 
     def _publish_snapshot(self, req: _Request, snap: KVSnapshot):
         """Count the export, run the chaos injector, and attach the
@@ -2180,7 +2322,10 @@ class GenerationServer:
             raise SnapshotUnsupported(
                 "speculative servers cannot adopt: the draft's dense "
                 "KV cache is not part of the KVSnapshot wire format")
-        if snapshot.version != WIRE_VERSION:
+        # v2 snapshots (single-chip geometry, no shard header) adopt as
+        # the legacy fallback: their payload layout IS the canonical
+        # shards=1 layout, so only the header generation differs
+        if snapshot.version not in (WIRE_VERSION - 1, WIRE_VERSION):
             raise SnapshotInvalid(
                 f"KVSnapshot wire version {snapshot.version} != "
                 f"supported {WIRE_VERSION}")
@@ -2188,14 +2333,18 @@ class GenerationServer:
             raise SnapshotInvalid("KVSnapshot checksum mismatch")
         if (snapshot.kv_dtype != self.kv_dtype
                 or snapshot.page_size != self._ps
-                or snapshot.page_token_bytes != self._page_token_bytes):
+                or snapshot.page_token_bytes != self._page_token_bytes
+                or snapshot.head_layout != "canonical"):
             raise SnapshotUnsupported(
                 f"snapshot geometry (kv_dtype={snapshot.kv_dtype!r}, "
                 f"page_size={snapshot.page_size}, "
-                f"{snapshot.page_token_bytes} B/token) does not match "
+                f"{snapshot.page_token_bytes} B/token, "
+                f"head_layout={snapshot.head_layout!r}) does not match "
                 f"this server (kv_dtype={self.kv_dtype!r}, "
                 f"page_size={self._ps}, {self._page_token_bytes} "
-                f"B/token)")
+                f"B/token, head_layout='canonical'); the exporter's "
+                f"shard count ({snapshot.shards}) is free to differ — "
+                "adopt re-shards to the local mesh")
         plen = int(snapshot.prompt.shape[0])
         if (snapshot.count != len(snapshot.tokens)
                 or snapshot.pos != plen + snapshot.count - 1
@@ -2284,7 +2433,8 @@ class GenerationServer:
             if i not in shared:
                 dst[i] = self._bt[slot, i]
         prog = self._page_store_program()
-        self._pool = prog(self._pool, dst, padded_payload(snap, self._np))
+        self._pool = prog(self._pool, dst, self._reshard_snapshot(
+            padded_payload(snap, self._np)))
         # re-hash the pristine prompt chunk pages into this server's
         # prefix cache (the tail page already holds decoded tokens and
         # must NOT be registered under the whole-prompt tail key)
@@ -2431,6 +2581,18 @@ class GenerationServer:
         for name, prev in self._pa_prev.items():
             self._layer_by_name[name].paged_attention = prev
         self._pa_prev = {}
+        # same restore-on-close discipline for the mesh knob. The push
+        # is build-scoped (see _get_program), so normally there is
+        # nothing left to undo — this is the crash-safety net: if a
+        # build died between push and restore, un-push OUR mesh (and
+        # only ours — a sibling server's live Mesh is not ours to
+        # touch) under the trace lock so no build is mid-flight.
+        with GenerationServer._trace_lock:
+            for name, prev in self._mesh_prev.items():
+                layer = self._layer_by_name[name]
+                if self._mesh is not None and layer.paged_mesh is self._mesh:
+                    layer.paged_mesh = prev
+        self._mesh_prev = {}
 
     # ------------------------------------------------------------- stats
     def stats(self) -> dict:

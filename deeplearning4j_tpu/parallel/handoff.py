@@ -47,12 +47,26 @@ import numpy as np
 
 from deeplearning4j_tpu.parallel.resilience import ResilienceError
 
-#: KVSnapshot wire-format version. Bump on any layout change; adopters
-#: refuse versions they do not speak (typed ``SnapshotInvalid``).
+#: KVSnapshot wire-format version. Bump on any layout change. Unknown
+#: versions are refused typed ``SnapshotInvalid``; KNOWN-but-different
+#: versions (a v3 snapshot at a v2-geometry reader, or vice versa) are
+#: refused typed ``SnapshotUnsupported`` with the full geometry tuple in
+#: the message — never a checksum error, never a silent truncation.
 #: v2: ``deadline_remaining`` joined the resume header — the request's
 #: remaining Deadline budget in seconds (never an absolute timestamp, so
 #: the field survives wall-clock skew between exporter and adopter).
-WIRE_VERSION = 2
+#: v3: mesh-aware page geometry — ``shards`` (the exporter's
+#: tensor-parallel degree) and ``head_layout`` joined the header. The
+#: page payload is ALWAYS the canonical host layout (full
+#: ``[NP, H, ps, d]`` stacks — export gathers the head shards back
+#: together), so any-tp adopters re-shard locally and a tp=2 exporter
+#: hands off to a tp=4 or tp=1 adopter without a re-pack.
+WIRE_VERSION = 3
+
+#: the one payload layout v3 speaks: full head axis, page-major. Kept as
+#: a named constant so a future device-native layout bumps the wire
+#: version instead of silently reinterpreting bytes.
+CANONICAL_HEAD_LAYOUT = "canonical"
 
 _MAGIC = b"KVSN"
 
@@ -108,12 +122,14 @@ class KVSnapshot:
                  "key", "temperature", "top_k", "seed", "eos_id",
                  "max_tokens", "kv_dtype", "page_size",
                  "page_token_bytes", "page_digests", "payload",
-                 "deadline_remaining", "checksum")
+                 "deadline_remaining", "shards", "head_layout",
+                 "checksum")
 
     def __init__(self, *, version, prompt, tokens, pos, count, last, key,
                  temperature, top_k, seed, eos_id, max_tokens, kv_dtype,
                  page_size, page_token_bytes, page_digests, payload,
-                 deadline_remaining=None, checksum=None):
+                 deadline_remaining=None, shards=1,
+                 head_layout=CANONICAL_HEAD_LAYOUT, checksum=None):
         self.version = int(version)
         self.prompt = np.asarray(prompt, np.int64)
         self.tokens = [int(t) for t in tokens]
@@ -137,12 +153,23 @@ class KVSnapshot:
         #: rule). None = the request carried no deadline.
         self.deadline_remaining = None if deadline_remaining is None \
             else float(deadline_remaining)
+        #: v3 mesh-aware page geometry: how many head shards the
+        #: EXPORTING server decoded over (diagnostics — the payload is
+        #: canonical regardless) and the payload's head-axis layout.
+        #: A version-2 snapshot keeps the implied single-chip values.
+        self.shards = int(shards)
+        self.head_layout = str(head_layout)
         self.checksum = checksum if checksum is not None \
             else self.content_digest()
 
     # ------------------------------------------------------ integrity
     def _header(self) -> dict:
-        return {
+        # the sharded-geometry fields join the header at v3 ONLY: a
+        # version-2 snapshot built by this writer (downgrade_snapshot)
+        # stays byte-identical — header, checksum and framing — to one
+        # a pre-v3 writer would emit, which is what keeps the v2 adopt
+        # fallback honest
+        hdr = {
             "version": self.version,
             "prompt": self.prompt.tolist(),
             "tokens": self.tokens,
@@ -164,6 +191,10 @@ class KVSnapshot:
             "leaves": [[vn, leaf, str(a.dtype), list(a.shape)]
                        for vn, leaf, a in _leaf_items(self.payload)],
         }
+        if self.version >= 3:
+            hdr["shards"] = self.shards
+            hdr["head_layout"] = self.head_layout
+        return hdr
 
     def content_digest(self) -> bytes:
         """sha256 over the canonical header AND every payload byte —
@@ -201,21 +232,45 @@ class KVSnapshot:
         parts.append(self.checksum)
         return b"".join(parts)
 
+    #: wire versions this reader can PARSE (framing + header keys).
+    #: Parseable is weaker than adoptable: a cross-version read is
+    #: refused typed AFTER the header parse, so the refusal can name the
+    #: full geometry tuple instead of degenerating into a checksum error.
+    KNOWN_VERSIONS = (2, 3)
+
     @classmethod
-    def from_bytes(cls, blob: bytes) -> "KVSnapshot":
+    def from_bytes(cls, blob: bytes, *,
+                   supported: int = WIRE_VERSION) -> "KVSnapshot":
+        """Deserialize one snapshot. ``supported`` is the reader's own
+        wire generation (a v2-geometry decode tier passes 2): a KNOWN
+        version that differs from it fails typed ``SnapshotUnsupported``
+        with the geometry tuple (version/shards/head_layout/kv_dtype/
+        page geometry) in the message — never a checksum error, never a
+        silent truncation — while an UNKNOWN version fails
+        ``SnapshotInvalid`` before any parsing is trusted."""
         if len(blob) < len(_MAGIC) + 6 or not blob.startswith(_MAGIC):
             raise SnapshotInvalid("not a KVSnapshot byte stream")
         off = len(_MAGIC)
         version, hlen = struct.unpack_from("<HI", blob, off)
-        if version != WIRE_VERSION:
+        if version not in cls.KNOWN_VERSIONS:
             raise SnapshotInvalid(
                 f"KVSnapshot wire version {version} != supported "
-                f"{WIRE_VERSION}")
+                f"{supported}")
         off += 6
         try:
             hdr = json.loads(blob[off:off + hlen].decode())
         except Exception as e:
             raise SnapshotInvalid(f"unreadable snapshot header: {e}")
+        if version != supported:
+            raise SnapshotUnsupported(
+                "cross-version KVSnapshot refused before adoption: "
+                f"geometry (version={version}, "
+                f"shards={hdr.get('shards', 1)}, "
+                f"head_layout={hdr.get('head_layout', CANONICAL_HEAD_LAYOUT)!r}, "
+                f"kv_dtype={hdr.get('kv_dtype')!r}, "
+                f"page_size={hdr.get('page_size')}, "
+                f"page_token_bytes={hdr.get('page_token_bytes')}) from a "
+                f"v{version} writer at a v{supported}-geometry reader")
         off += hlen
         payload: Dict[str, Dict[str, np.ndarray]] = {}
         for vn, leaf, dtype, shape in hdr["leaves"]:
@@ -238,6 +293,8 @@ class KVSnapshot:
                           for d in hdr["page_digests"]],
             payload=payload,
             deadline_remaining=hdr["deadline_remaining"],
+            shards=hdr.get("shards", 1),
+            head_layout=hdr.get("head_layout", CANONICAL_HEAD_LAYOUT),
             checksum=checksum)
         if not snap.verify():
             raise SnapshotInvalid("KVSnapshot checksum mismatch")
@@ -245,8 +302,9 @@ class KVSnapshot:
 
 
 def pack_snapshot(*, req, pos, count, last, key, kv_dtype, page_size,
-                  page_token_bytes, page_digests, fetched,
-                  n_pages) -> KVSnapshot:
+                  page_token_bytes, page_digests, fetched, n_pages,
+                  shards=1,
+                  head_layout=CANONICAL_HEAD_LAYOUT) -> KVSnapshot:
     """Assemble a ``KVSnapshot`` from the server's host mirrors plus one
     fetched page stack. ``fetched`` is the block-table-width device
     fetch ``{vertex: {leaf: [NP, ...]}}``; only the first ``n_pages``
@@ -268,7 +326,32 @@ def pack_snapshot(*, req, pos, count, last, key, kv_dtype, page_size,
         eos_id=req.eos_id, max_tokens=req.max_tokens, kv_dtype=kv_dtype,
         page_size=page_size, page_token_bytes=page_token_bytes,
         page_digests=list(page_digests)[:n], payload=payload,
-        deadline_remaining=remaining)
+        deadline_remaining=remaining, shards=shards,
+        head_layout=head_layout)
+
+
+def downgrade_snapshot(snap: KVSnapshot) -> KVSnapshot:
+    """Re-emit a v3 snapshot as wire v2 — byte-identical (header,
+    framing, checksum) to what a pre-v3 writer would have produced for
+    the same request, which is possible precisely because the v3 payload
+    layout IS the v2 layout (canonical host stacks). The bridge for
+    shipping to a fleet tier still running v2-geometry readers; refuses
+    a non-canonical layout loudly rather than emit bytes a v2 reader
+    would misinterpret."""
+    if snap.head_layout != CANONICAL_HEAD_LAYOUT:
+        raise SnapshotUnsupported(
+            f"cannot downgrade a {snap.head_layout!r}-layout snapshot "
+            "to wire v2: v2 readers only speak the canonical host "
+            "layout")
+    return KVSnapshot(
+        version=2, prompt=snap.prompt, tokens=list(snap.tokens),
+        pos=snap.pos, count=snap.count, last=snap.last, key=snap.key,
+        temperature=snap.temperature, top_k=snap.top_k, seed=snap.seed,
+        eos_id=snap.eos_id, max_tokens=snap.max_tokens,
+        kv_dtype=snap.kv_dtype, page_size=snap.page_size,
+        page_token_bytes=snap.page_token_bytes,
+        page_digests=list(snap.page_digests), payload=snap.payload,
+        deadline_remaining=snap.deadline_remaining)
 
 
 def padded_payload(snap: KVSnapshot, np_pages: int

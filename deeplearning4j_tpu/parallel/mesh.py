@@ -39,6 +39,37 @@ def shard_map_compat(fn, *, mesh, in_specs, out_specs, check=None):
     return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
 
 
+class MeshGeometryError(ValueError):
+    """Typed, loud mesh-geometry failure: the requested tensor-parallel
+    degree does not divide the device count, or (raised at pool-build
+    time by the serving layer) the model's head count. A plain
+    ``ValueError`` subclass so legacy ``except ValueError`` callers keep
+    working, but catchable on its own by fleet factories that want to
+    fall back to a smaller tp."""
+
+
+def model_mesh(tp: int, devices=None) -> Mesh:
+    """1-D head-parallel (tensor-parallel) mesh over ``tp`` devices on
+    the ``model`` axis — the mesh the sharded paged decode path runs
+    over. Validation is loud and typed (``MeshGeometryError``): a silent
+    fallback to fewer chips would change the page budget the server
+    admitted against."""
+    if devices is None:
+        devices = jax.devices()
+    if tp < 1:
+        raise MeshGeometryError(f"tensor-parallel degree must be >= 1, got {tp}")
+    if tp > len(devices):
+        raise MeshGeometryError(
+            f"tensor-parallel degree {tp} exceeds the {len(devices)} "
+            "available devices")
+    if len(devices) % tp != 0:
+        raise MeshGeometryError(
+            f"device count {len(devices)} is not divisible by tp={tp}: "
+            "replica groups would overlap — pass an explicit device "
+            "subset instead")
+    return Mesh(np.array(devices[:tp]), (MODEL_AXIS,))
+
+
 def data_mesh(num_devices: Optional[int] = None, devices=None) -> Mesh:
     """1-D data-parallel mesh over the first ``num_devices`` devices (default all)."""
     if devices is None:
@@ -57,5 +88,6 @@ def data_model_mesh(data: int, model: int, devices=None) -> Mesh:
         devices = jax.devices()
     n = data * model
     if n > len(devices):
-        raise ValueError(f"Mesh {data}x{model} needs {n} devices, have {len(devices)}")
+        raise MeshGeometryError(
+            f"Mesh {data}x{model} needs {n} devices, have {len(devices)}")
     return Mesh(np.array(devices[:n]).reshape(data, model), (DATA_AXIS, MODEL_AXIS))

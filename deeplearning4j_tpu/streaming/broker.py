@@ -182,6 +182,14 @@ class StreamingBroker:
         self._stop.set()
         if self._server is not None:
             try:
+                # close() alone does NOT wake a thread already blocked in
+                # accept() on Linux — shutdown() does (EINVAL in the
+                # accepter), so the tick exits now instead of leaking
+                # until the join deadline
+                self._server.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
                 self._server.close()  # accept() raises -> clean tick exit
             except OSError:
                 pass

@@ -3,7 +3,7 @@ ParallelWrapper MultiGpuLenetMnistExample analog (one mesh instead of
 replica threads).
 
 Run: python examples/lenet_mesh_dataparallel.py
-Env: EXAMPLES_SMOKE=1 shrinks sizes and forces an 8-device CPU mesh.
+Env: EXAMPLES_SMOKE=1 shrinks sizes and forces a 4-device CPU mesh.
 """
 
 import os
@@ -16,11 +16,11 @@ if SMOKE:
     import jax
     jax.config.update("jax_platforms", "cpu")
     try:
-        jax.config.update("jax_num_cpu_devices", 8)
+        jax.config.update("jax_num_cpu_devices", 4)
     except AttributeError:  # jax < 0.5: only the XLA_FLAGS spelling exists
         os.environ["XLA_FLAGS"] = (
             os.environ.get("XLA_FLAGS", "")
-            + " --xla_force_host_platform_device_count=8")
+            + " --xla_force_host_platform_device_count=4")
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
@@ -37,7 +37,7 @@ def main():
     # rounds or the trailing partial round is dropped (with a warning)
     pw = ParallelWrapper(net, mesh=mesh, averaging_frequency=1)
     batch = 64
-    n = 2048 if SMOKE else (60000 // (batch * n_dev)) * batch * n_dev
+    n = 512 if SMOKE else (60000 // (batch * n_dev)) * batch * n_dev
 
     def image_batches(**kw):
         # MNIST iterator yields flat [B, 784] (the reference's contract);

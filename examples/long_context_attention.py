@@ -9,7 +9,7 @@ Two capabilities in one runnable demo:
    check both match single-device attention.
 
 Run: python examples/long_context_attention.py
-Env: EXAMPLES_SMOKE=1 -> CPU, T=256, 8 virtual devices for the SP part.
+Env: EXAMPLES_SMOKE=1 -> CPU, T=256, 4 virtual devices for the SP part.
 """
 
 import os
@@ -20,14 +20,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 SMOKE = bool(os.environ.get("EXAMPLES_SMOKE"))
 import jax
 
-if SMOKE:  # hermetic: CPU with a virtual 8-device mesh for the SP demo
+if SMOKE:  # hermetic: CPU with a virtual 4-device mesh for the SP demo
     jax.config.update("jax_platforms", "cpu")
     try:
-        jax.config.update("jax_num_cpu_devices", 8)
+        jax.config.update("jax_num_cpu_devices", 4)
     except AttributeError:  # jax < 0.5: only the XLA_FLAGS spelling exists
         os.environ["XLA_FLAGS"] = (
             os.environ.get("XLA_FLAGS", "")
-            + " --xla_force_host_platform_device_count=8")
+            + " --xla_force_host_platform_device_count=4")
 
 import numpy as np
 import jax.numpy as jnp

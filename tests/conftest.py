@@ -19,6 +19,14 @@ if "--xla_force_host_platform_device_count" not in os.environ.get(
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                                + " --xla_force_host_platform_device_count=8")
 
+# NOTE: do NOT enable jax's persistent compilation cache here. The suite
+# is compile-dominated and the cache looks like a free 1.5x, but with
+# this jaxlib the CPU executable DESERIALIZATION path is unsound: two
+# full-suite runs with the cache enabled segfaulted at random points
+# (one mid-trace "Garbage-collecting", one on a plain Python line — the
+# signature of delayed heap corruption), while cache-less runs of the
+# identical tree are stable.
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
@@ -196,6 +204,13 @@ def pytest_configure(config):
         "slow set)")
     config.addinivalue_line(
         "markers",
+        "mesh: tensor-parallel mesh-sharded decode tests (head-sharded "
+        "page pool over a model mesh, tp>1 greedy/sampled parity, "
+        "cross-TP snapshot handoff, replica-group fleets — CPU-fast on "
+        "8 forced virtual devices; runs in tier-1, deliberately NOT in "
+        "the slow set)")
+    config.addinivalue_line(
+        "markers",
         "pallas: Pallas-kernel parity tests (paged-attention helper seam "
         "XLA-vs-kernel bit-exactness in interpret mode, backend "
         "selection, backend-tagged program caches — CPU-fast; runs in "
@@ -220,7 +235,8 @@ def _lock_order_debug(request):
             or request.node.get_closest_marker("disagg")
             or request.node.get_closest_marker("runtime")
             or request.node.get_closest_marker("knn")
-            or request.node.get_closest_marker("pallas")):
+            or request.node.get_closest_marker("pallas")
+            or request.node.get_closest_marker("mesh")):
         yield
         return
     from deeplearning4j_tpu.analysis import instrument

@@ -30,6 +30,7 @@ from deeplearning4j_tpu.parallel.handoff import (WIRE_VERSION, KVSnapshot,
                                                  SnapshotUnsupported,
                                                  adopt_request,
                                                  corrupt_snapshot,
+                                                 downgrade_snapshot,
                                                  export_request)
 from deeplearning4j_tpu.parallel.resilience import (ChaosPolicy,
                                                     ResilienceError,
@@ -210,6 +211,83 @@ class TestSnapshotRoundTrip:
 def _leaves(snap):
     from deeplearning4j_tpu.parallel.handoff import _leaf_items
     return list(_leaf_items(snap.payload))
+
+
+@pytest.mark.handoff
+class TestWireV3ForwardCompat:
+    """The v3 wire generation: sharded-geometry header fields, the
+    typed cross-version refusal (BEFORE the checksum — a version skew
+    must never masquerade as corruption), and the v2 downgrade bridge
+    for fleet tiers still running v2-geometry readers."""
+
+    def test_v3_header_roundtrip_tp1(self, lm):
+        """A single-chip server emits v3 with the implied single-chip
+        geometry, and the new fields survive the wire round-trip."""
+        _out, snap = _run_to_snapshot(lm, GREEDY)
+        assert snap.version == WIRE_VERSION == 3
+        assert snap.shards == 1
+        assert snap.head_layout == "canonical"
+        back = KVSnapshot.from_bytes(snap.to_bytes())
+        assert back.verify()
+        assert (back.shards, back.head_layout) == (1, "canonical")
+
+    def test_v3_rejected_by_v2_reader_typed(self, lm):
+        """A v2-geometry reader (``supported=2``) refuses a v3 blob
+        with SnapshotUnsupported naming the full geometry tuple —
+        never a checksum error, never a silent truncation. Flipping a
+        payload byte first proves the refusal fires BEFORE the
+        integrity gate even looks."""
+        _out, snap = _run_to_snapshot(lm, GREEDY)
+        blob = snap.to_bytes()
+        with pytest.raises(SnapshotUnsupported, match="geometry") as ei:
+            KVSnapshot.from_bytes(blob, supported=2)
+        msg = str(ei.value)
+        for frag in ("version=3", "shards=1", "head_layout='canonical'",
+                     "page_size="):
+            assert frag in msg, msg
+        assert "checksum" not in msg
+        mid = len(blob) - 8                    # corrupt payload bytes
+        bad = blob[:mid] + bytes([blob[mid] ^ 0xFF]) + blob[mid + 1:]
+        with pytest.raises(SnapshotUnsupported, match="geometry"):
+            KVSnapshot.from_bytes(bad, supported=2)
+
+    def test_v2_rejected_by_v3_reader_typed(self, lm):
+        """And the mirror image: a v2 blob at this (v3-geometry) reader
+        fails the same typed way with the same tuple in the message."""
+        _out, snap = _run_to_snapshot(lm, GREEDY)
+        blob2 = downgrade_snapshot(snap).to_bytes()
+        with pytest.raises(SnapshotUnsupported, match="geometry") as ei:
+            KVSnapshot.from_bytes(blob2)
+        assert "version=2" in str(ei.value)
+        assert "checksum" not in str(ei.value)
+
+    def test_unknown_version_invalid_before_parse(self, lm):
+        """A version NO reader generation knows is SnapshotInvalid (not
+        Unsupported): nothing about the header can be trusted, and the
+        gate fires before the (now stale) checksum can confuse it."""
+        _out, snap = _run_to_snapshot(lm, GREEDY)
+        snap.version = 99
+        with pytest.raises(SnapshotInvalid, match="version"):
+            KVSnapshot.from_bytes(snap.to_bytes())
+
+    def test_downgraded_v2_snapshot_adopts_bitexact(self, lm):
+        """downgrade_snapshot emits a wire image a v2 reader parses
+        (same payload, version-2 header/checksum), and the adopt gate
+        keeps a one-generation legacy fallback: the v2 snapshot resumes
+        bit-exactly on a live server."""
+        p = GREEDY[0]
+        ref = greedy_generate(lm, p[None], 12, V)[0]
+        out, snap = _run_to_snapshot(lm, GREEDY)
+        np.testing.assert_array_equal(out, ref)
+        v2 = KVSnapshot.from_bytes(downgrade_snapshot(snap).to_bytes(),
+                                   supported=2)
+        assert v2.version == 2
+        assert v2.verify()
+        with serving(lm, V, slots=2, page_size=4) as dst:
+            res = adopt_request(dst, v2).result(timeout=120)
+            st = dst.stats()["handoff"]
+        np.testing.assert_array_equal(np.asarray(res), ref)
+        assert st["resumes"] == 1 and st["fallbacks"] == 0
 
 
 @pytest.mark.handoff
