@@ -847,6 +847,211 @@ def bench_serve_fleet(n_requests: int = 96, repeats: int = 3,
     }
 
 
+def bench_serve_federated(n_requests: int = 64, repeats: int = 2,
+                          window: int = 24, vocab: int = 17,
+                          n_crash: int = 6, crash_steps: int = 20):
+    """Cross-host fleet federation: generation serving over N fleet-host
+    *processes* (each a ReplicaFleet behind the framed socket RPC)
+    fronted by one FleetFederation router. Three legs over the same two
+    spawned host processes:
+
+    1. federation over H0 only (timed),
+    2. federation over H0+H1 (timed) — asserts aggregate req/s scaling
+       >= 1.7x; the hosts' decode loops are stall-chaos dominated
+       (sleep-bound, not FLOPs-bound), so two processes must deliver
+       near-2x even on a one-core bench box,
+    3. crash drill: a fresh federation over both hosts, SIGKILL H1
+       mid-stream once the router holds published KV snapshots, and
+       assert IN-BENCH that every completion is bit-exact vs its serial
+       reference (the victims resume on H0 via cross-host snapshot
+       adoption — ``handoff_resumes >= 1`` proves at least one adopted
+       rather than replayed from token 0), that zero futures were lost,
+       and that the federated ledger balances."""
+    import tempfile
+
+    from deeplearning4j_tpu.models.zoo import (TransformerLM,
+                                               greedy_generate,
+                                               sample_generate)
+    from deeplearning4j_tpu.parallel.federation import (FleetFederation,
+                                                        spawn_host)
+    from deeplearning4j_tpu.parallel.resilience import ResilienceError
+
+    net = TransformerLM(num_labels=vocab, max_length=32, d_model=16,
+                        n_heads=2, n_blocks=1, seed=3).init()
+    rng = np.random.default_rng(42)
+    # deeper requests than the in-process fleet bench: each decode step
+    # stalls, so length amortizes the per-request RPC + routing overhead
+    # and keeps both hosts' slots full behind the client window
+    shapes = [(3, 8), (5, 9), (4, 10)]
+
+    def mk_specs(n, steps=None):
+        specs = []
+        for i in range(n):
+            plen, st = shapes[i % len(shapes)]
+            p = rng.integers(1, vocab, size=plen).astype(np.int64)
+            specs.append((p, steps or st, 0.0, 0, 0) if i % 2 == 0
+                         else (p, steps or st, 0.9, 5, 2000 + i))
+        return specs
+
+    def mk_refs(specs):
+        return [greedy_generate(net, p[None], st, vocab)[0]
+                if temp == 0.0 else
+                sample_generate(net, p[None], st, vocab, temperature=temp,
+                                top_k=top_k, seed=seed)[0]
+                for p, st, temp, top_k, seed in specs]
+
+    specs = mk_specs(n_requests)
+    refs = mk_refs(specs)
+
+    def submit_retry(fed, spec):
+        p, st, temp, top_k, seed = spec
+        t_end = time.monotonic() + SUB_BENCH_TIMEOUT_S
+        while True:
+            try:
+                return fed.submit(p, st, temperature=temp, top_k=top_k,
+                                  seed=seed,
+                                  deadline_s=SUB_BENCH_TIMEOUT_S)
+            except ResilienceError:
+                if time.monotonic() > t_end:
+                    raise
+                time.sleep(0.01)
+
+    def run_pass(fed):
+        sem = threading.BoundedSemaphore(window)
+        done_at = [None] * n_requests
+        t_submit = [None] * n_requests
+
+        def make_cb(i):
+            def cb(_fut):
+                done_at[i] = time.perf_counter()
+                sem.release()
+            return cb
+
+        t0 = time.perf_counter()
+        futs = []
+        for i, spec in enumerate(specs):
+            sem.acquire()
+            t_submit[i] = time.perf_counter()
+            f = submit_retry(fed, spec)
+            f.add_done_callback(make_cb(i))
+            futs.append(f)
+        outs = [f.result(timeout=SUB_BENCH_TIMEOUT_S) for f in futs]
+        total = time.perf_counter() - t0
+        bad = sum(1 for o, ref in zip(outs, refs)
+                  if not np.array_equal(np.asarray(o), ref))
+        if bad:
+            raise RuntimeError(
+                f"{bad}/{n_requests} federated completions differ from "
+                "their serial references")
+        lat_ms = sorted((d - s) * 1e3
+                        for d, s in zip(done_at, t_submit))
+        return total, lat_ms
+
+    hb_dir = tempfile.mkdtemp(prefix="fed_bench_hb_")
+    spec_base = {"heartbeat_dir": hb_dir, "heartbeat_interval": 0.05,
+                 "builder_kwargs": {
+                     "replicas": 1, "slots": 4, "snapshot_every": 1,
+                     "max_length": 32, "steps_per_dispatch": 1,
+                     "chaos": {"stall_rate": 1.0, "stall_s": 0.02}}}
+    hh0 = spawn_host(dict(spec_base, hid="h0"))
+    hh1 = spawn_host(dict(spec_base, hid="h1"))
+    try:
+        results = {}
+        for nhosts, handles in ((1, [hh0]), (2, [hh0, hh1])):
+            fed = FleetFederation(handles, heartbeat_dir=hb_dir,
+                                  max_pending=2 * n_requests)
+            try:
+                run_pass(fed)  # warm every host program, both paths
+                total = 0.0
+                lat_ms = None
+                for _ in range(repeats):
+                    t, lat_ms = run_pass(fed)
+                    total += t
+                st = fed.stats()["federation"]
+                lost = (st["submitted"] - st["completed"]
+                        - st["rejected_submits"])
+                if lost or st["inflight"] or st["parked"] \
+                        or st["failed"] or st["expired"]:
+                    raise RuntimeError(
+                        f"federation ({nhosts} host) leaked {lost} "
+                        f"futures (inflight {st['inflight']}, parked "
+                        f"{st['parked']}, failed {st['failed']}, "
+                        f"expired {st['expired']})")
+            finally:
+                fed.close()
+            results[nhosts] = (repeats * n_requests / total, lat_ms)
+
+        # ---- leg 3: whole-process SIGKILL mid-stream -----------------
+        crash_specs = mk_specs(n_crash, steps=crash_steps)
+        crash_refs = mk_refs(crash_specs)
+        fed = FleetFederation([hh0, hh1], heartbeat_dir=hb_dir,
+                              max_pending=2 * n_crash)
+        try:
+            futs = [submit_retry(fed, sp) for sp in crash_specs]
+            t_end = time.monotonic() + 60.0
+            while fed.stats()["federation"]["snapshots"] < 2:
+                if time.monotonic() > t_end:
+                    raise RuntimeError(
+                        "hosts never published KV snapshots to the "
+                        "router — nothing to adopt on crash")
+                time.sleep(0.01)
+            hh1.kill()   # SIGKILL the whole process: no flush, no goodbye
+            outs = [f.result(timeout=SUB_BENCH_TIMEOUT_S) for f in futs]
+            bad = sum(1 for o, ref in zip(outs, crash_refs)
+                      if not np.array_equal(np.asarray(o), ref))
+            if bad:
+                raise RuntimeError(
+                    f"{bad}/{n_crash} completions differ from serial "
+                    "after the host SIGKILL — cross-host migration is "
+                    "not bit-exact")
+            st = fed.stats()["federation"]
+            lost = (st["submitted"] - st["completed"]
+                    - st["rejected_submits"])
+            if lost or st["inflight"] or st["parked"] or st["failed"] \
+                    or st["expired"]:
+                raise RuntimeError(
+                    f"federation leaked {lost} futures across the host "
+                    f"SIGKILL (inflight {st['inflight']}, parked "
+                    f"{st['parked']}, failed {st['failed']}, expired "
+                    f"{st['expired']})")
+            if st["deaths"] < 1:
+                raise RuntimeError("the SIGKILL was never detected as a "
+                                   "host death")
+            if st["handoff_resumes"] < 1:
+                raise RuntimeError(
+                    "no victim resumed from an adopted snapshot "
+                    f"(resumes {st['handoff_resumes']}, fallbacks "
+                    f"{st['handoff_fallbacks']}) — the crash drill must "
+                    "exercise cross-host adoption, not just token-0 "
+                    "replay")
+            crash_st = st
+        finally:
+            fed.close()
+    finally:
+        hh0.terminate()
+        if hh1.alive:
+            hh1.kill()
+
+    req_s_1, _ = results[1]
+    req_s_2, lat_ms = results[2]
+    scaling = req_s_2 / req_s_1
+    if scaling < 1.7:
+        raise RuntimeError(
+            f"federation 1->2 host scaling {scaling:.2f}x — below the "
+            "1.7x bar on a stall-dominated workload")
+    return {
+        "serve_federated_req_s": _sane("serve_federated_req_s", req_s_2),
+        "serve_federated_1host_req_s": _sane("serve_federated_1host_req_s",
+                                             req_s_1),
+        "serve_federated_scaling": scaling,
+        **_serve_latency_quantiles(lat_ms, "serve_federated"),
+        "serve_federated_deaths": float(crash_st["deaths"]),
+        "serve_federated_handoff_resumes": float(
+            crash_st["handoff_resumes"]),
+        "serve_federated_redispatched": float(crash_st["redispatched"]),
+    }
+
+
 def bench_serve_handoff(n_requests: int = 64, vocab: int = 17,
                         steps: int = 48, kill_at_tokens: int = 80):
     """Crash-durable serving: what does a mid-stream replica death COST?
@@ -2411,6 +2616,8 @@ SANITY_CEILING = {
     "serve_chaos_req_s": 1e8,
     "serve_fleet_req_s": 1e8,
     "serve_fleet_1rep_req_s": 1e8,
+    "serve_federated_req_s": 1e8,
+    "serve_federated_1host_req_s": 1e8,
     "serve_handoff_req_s": 1e8,
     "serve_restart_req_s": 1e8,
     "serve_restart_steady_req_s": 1e8,
@@ -2505,6 +2712,14 @@ METRIC_UNIT = {
     "serve_fleet_deaths": "",
     "serve_fleet_restarts": "",
     "serve_fleet_redispatched": "",
+    "serve_federated_req_s": "req/s",
+    "serve_federated_1host_req_s": "req/s",
+    "serve_federated_scaling": "x",
+    "serve_federated_p50_ms": "ms",
+    "serve_federated_p99_ms": "ms",
+    "serve_federated_deaths": "",
+    "serve_federated_handoff_resumes": "",
+    "serve_federated_redispatched": "",
     "serve_restart_req_s": "req/s",
     "serve_restart_steady_req_s": "req/s",
     "serve_restart_p99_ms": "ms",
@@ -2815,7 +3030,8 @@ def main():
              "word2vec", "doc2vec", "attention", "paged_attn",
              "fit_e2e", "eval_e2e",
              "guard_overhead", "metrics_overhead", "inference_serve",
-             "serve_chaos", "serve_fleet", "serve_handoff", "serve_disagg",
+             "serve_chaos", "serve_fleet", "serve_federated",
+             "serve_handoff", "serve_disagg",
              "serve_soak", "serve_restart",
              "generate_serve", "generate_longtail", "generate_mesh",
              "quant_serve", "quant_infer", "knn_serve")
@@ -2886,6 +3102,9 @@ def main():
     if which in ("all", "serve_fleet"):
         _sub_metric(extras, "serve_fleet", bench_serve_fleet)
         headline and headline.sample("post-serve-fleet")
+    if which in ("all", "serve_federated"):
+        _sub_metric(extras, "serve_federated", bench_serve_federated)
+        headline and headline.sample("post-serve-federated")
     if which in ("all", "serve_handoff"):
         _sub_metric(extras, "serve_handoff", bench_serve_handoff)
         headline and headline.sample("post-serve-handoff")

@@ -26,6 +26,7 @@ rank  lock
 30    ParallelInference._drain_cv, GenerationServer._cond,
       EmbeddingIndex._drain_cv
 35    ReplicaFleet._cond
+38    FleetFederation._cond
 40    KerasBackendServer._lock
 55    LoopSupervisor._lock
 60    AdmissionController._lock
@@ -179,6 +180,7 @@ def _targets() -> Dict[type, Dict[str, Tuple[int, bool]]]:
     from deeplearning4j_tpu.nearestneighbors.server import (
         NearestNeighborsServer,
     )
+    from deeplearning4j_tpu.parallel.federation import FleetFederation
     from deeplearning4j_tpu.parallel.fleet import ReplicaFleet
     from deeplearning4j_tpu.parallel.generation import GenerationServer
     from deeplearning4j_tpu.parallel.inference import ParallelInference
@@ -197,6 +199,7 @@ def _targets() -> Dict[type, Dict[str, Tuple[int, bool]]]:
         ServingLoop: {"_cond": (25, True)},
         GenerationServer: {"_cond": (30, True), "_trace_lock": (28, False)},
         ReplicaFleet: {"_cond": (35, True)},
+        FleetFederation: {"_cond": (38, True)},
         KerasBackendServer: {"_lock": (40, False)},
         LoopSupervisor: {"_lock": (55, False)},
         AdmissionController: {"_lock": (60, False)},

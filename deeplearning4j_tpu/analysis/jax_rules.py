@@ -69,6 +69,9 @@ HOT_FUNCTIONS = {
     "_shard_pool",                                # mesh pool placement
     "_reshard_snapshot",                          # adopt-side payload reshard
     "_sharded_write_attend",                      # shard_map write+attend body
+    "_gossip_loop",                               # federation router tick
+    "_route_host",                                # federation dispatch path
+    "_harvest_host",                              # federation crash harvest
 }
 
 SYNC_BUILTINS = {"float", "bool", "int"}

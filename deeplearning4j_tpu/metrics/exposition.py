@@ -57,12 +57,19 @@ def _le(upper):
 def render_text(sources):
     """``sources``: iterable of ``(inject_labels, registry)``. Injected
     labels are prepended to every sample of that registry; collisions
-    resolve in favor of the sample's own label."""
+    resolve in favor of the sample's own label. A source's second
+    element may also be a pre-collected families list (the
+    ``_snapshot_families()`` shape, possibly round-tripped through
+    JSON) — the federation router exposes each remote host's last
+    stats-gossip families this way, so one scrape of the router shows
+    every host without a live socket per scrape."""
     merged = {}   # name -> {"help":, "kind":, "samples": [(labels, data)]}
     order = []
     for inject, reg in sources:
         inject = dict(inject or {})
-        for fam in reg._snapshot_families():
+        fams = (reg._snapshot_families()
+                if hasattr(reg, "_snapshot_families") else reg)
+        for fam in fams:
             slot = merged.get(fam["name"])
             if slot is None:
                 slot = {"help": fam["help"], "kind": fam["kind"],

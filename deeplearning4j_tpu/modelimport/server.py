@@ -479,6 +479,17 @@ class KerasBackendServer:
         sources = [({}, self.metrics)]
         seen = {id(self.metrics)}
         for mid, target in list(gens.items()) + list(infs.items()):
+            # a federated target exposes one source per remote host
+            # (injected host= label alongside model=) via
+            # metrics_sources(); plain targets expose one registry
+            ms = getattr(target, "metrics_sources", None)
+            if ms is not None:
+                for lbls, src in ms():
+                    if id(src) not in seen:
+                        seen.add(id(src))
+                        sources.append(({"model": mid, **(lbls or {})},
+                                        src))
+                continue
             reg = getattr(target, "metrics", None)
             if reg is not None and id(reg) not in seen:
                 seen.add(id(reg))

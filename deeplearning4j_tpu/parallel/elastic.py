@@ -472,11 +472,18 @@ class FailureDetector:
                 out[name[:-len(".heartbeat")]] = None
         return out
 
-    def dead_workers(self, now: Optional[float] = None) -> list:
+    def dead_workers(self, now: Optional[float] = None,
+                     timeout: Optional[float] = None) -> list:
         """Workers whose heartbeat has not advanced for ``timeout``
         observer-seconds (or whose file is unreadable). ``now`` overrides
-        the observer's ``time.monotonic()`` reading — test hook."""
+        the observer's ``time.monotonic()`` reading — test hook.
+        ``timeout`` overrides the constructor's for this call only, so
+        one detector can answer both a short *suspect* question and a
+        long *dead* question off the same observation table (the fleet
+        federation marks a host suspect on missed beats well before the
+        dead verdict — or any TCP error — lands)."""
         mono = time.monotonic() if now is None else now
+        stale_after = self.timeout if timeout is None else timeout
         seen = self.workers()
         # forget workers whose heartbeat file vanished, so a re-created
         # one starts a fresh staleness window
@@ -493,7 +500,7 @@ class FailureDetector:
                 # first observation, or the persisted ts advanced since
                 # the last scan: liveness proven on the observer's clock
                 self._observed[worker] = (ts, mono)
-            elif mono - prev[1] > self.timeout:
+            elif mono - prev[1] > stale_after:
                 dead.append(worker)
         return sorted(dead)
 

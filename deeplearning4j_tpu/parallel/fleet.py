@@ -439,16 +439,69 @@ class ReplicaFleet:
             raise
         if routed:
             return fut
+        exc = self._unrouted_error(freq, reason)
+        self._resolve(freq, None, exc, rejected=True)
+        raise exc
+
+    def _unrouted_error(self, freq: _FleetRequest,
+                        reason: str) -> Exception:
+        """The typed submit-time shed for a request no replica took."""
         if reason == "breaker":
-            exc: Exception = CircuitOpen(
+            return CircuitOpen(
                 "every healthy replica's circuit breaker is open")
-        elif reason == "rejected" and isinstance(freq.last_error,
-                                                 ResilienceError):
-            exc = freq.last_error
-        else:
-            exc = ReplicaUnavailable(
-                "no replica can accept the request (all dead, draining, "
-                "or restarting)")
+        if reason == "rejected" and isinstance(freq.last_error,
+                                               ResilienceError):
+            return freq.last_error
+        return ReplicaUnavailable(
+            "no replica can accept the request (all dead, draining, "
+            "or restarting)")
+
+    def adopt(self, snapshot: KVSnapshot, *,
+              deadline_s: Optional[float] = None) -> Future:
+        """Accept a harvested ``KVSnapshot`` as a brand-new fleet
+        request: the next dispatch resumes it at position N on the
+        healthiest (decode-capable) replica via ``adopt_request``, with
+        the token-0 fallback replaying the original call reconstructed
+        from the snapshot header — bit-exact either way (the fold_in
+        key schedule makes regeneration exact), the snapshot only saves
+        the recompute. The deadline follows the handoff precedence: an
+        explicit ``deadline_s`` wins, else the snapshot's
+        ``deadline_remaining`` duration re-arms here (monotonic-deadline
+        rule — it survives wall-clock skew between hosts), else no
+        deadline. This is the entry the cross-host federation uses to
+        re-home a dead host's in-flight requests on a surviving fleet."""
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError("deadline_s must be positive")
+        if deadline_s is None and snapshot.deadline_remaining is not None:
+            # expired-in-flight budgets still dispatch once: the typed
+            # DeadlineExceeded must come from the routing path, not a
+            # constructor ValueError the wire protocol can't express
+            deadline_s = max(0.001, snapshot.deadline_remaining)
+        args = (snapshot.prompt, snapshot.max_tokens)
+        kwargs = {"temperature": snapshot.temperature,
+                  "top_k": snapshot.top_k, "seed": snapshot.seed,
+                  "eos_id": snapshot.eos_id}
+        with self._cond:
+            if self._closing:
+                raise RuntimeError("ReplicaFleet is closed")
+        self.admission.acquire()  # fleet-wide high-watermark (429)
+        fut = Future()
+        fut.add_done_callback(lambda _f: self.admission.release())
+        freq = _FleetRequest(
+            args, kwargs,
+            None if deadline_s is None else Deadline(deadline_s), fut)
+        freq.snapshot = snapshot
+        with self._cond:
+            self._inflight_reqs.add(freq)
+        self._m_submitted.inc()
+        try:
+            routed, reason = self._route_once(freq)
+        except ValueError:
+            self._resolve(freq, None, None)  # unlink + release admission
+            raise
+        if routed:
+            return fut
+        exc = self._unrouted_error(freq, reason)
         self._resolve(freq, None, exc, rejected=True)
         raise exc
 
@@ -1046,6 +1099,15 @@ class ReplicaFleet:
             if exc is None:
                 freq.future.set_result(value)
             else:
+                # the newest harvested snapshot rides the failed future
+                # (same contract as GenerationServer's): whoever holds
+                # it — the federation host publisher — can re-home the
+                # request at its final crash-durable position
+                snap = freq.snapshot
+                if snap is not None:
+                    cur = getattr(freq.future, "_kv_snapshot", None)
+                    if cur is None or snap.count > cur.count:
+                        freq.future._kv_snapshot = snap
                 freq.future.set_exception(exc)
         except Exception:
             pass  # caller cancelled the fleet future: outcome dropped
@@ -1093,6 +1155,24 @@ class ReplicaFleet:
                             and now - freq.t_dispatch
                             >= self._hedge_after_s):
                         hedges.append(freq)
+            # crash-durable publication: mirror each live attempt's
+            # newest periodic snapshot (generation servers attach them
+            # to the inner future as they decode) onto the fleet
+            # request and the caller-facing future, so a host-level
+            # wrapper (federation.FleetHost) can ship the newest stream
+            # position off-process without reaching into replica
+            # internals
+            for freq in self._inflight_reqs:
+                for inner in freq.active.values():
+                    snap = getattr(inner, "_kv_snapshot", None)
+                    if snap is not None and (
+                            freq.snapshot is None
+                            or snap.count > freq.snapshot.count):
+                        freq.snapshot = snap
+                if freq.snapshot is not None:
+                    cur = getattr(freq.future, "_kv_snapshot", None)
+                    if cur is None or freq.snapshot.count > cur.count:
+                        freq.future._kv_snapshot = freq.snapshot
         for rid in spawn:
             self._respawn(rid)
         for freq in work:

@@ -301,6 +301,41 @@ class KVSnapshot:
         return snap
 
 
+def peek_snapshot(blob: bytes) -> dict:
+    """Parse ONLY the wire framing and JSON header of a serialized
+    snapshot — no payload copy, no checksum pass — for routers (the
+    fleet federation) that ship snapshots as opaque bytes but need the
+    stream position to order competing harvests. Returns a dict with
+    ``version``, ``count``, ``pos``, ``tokens`` (generated so far),
+    ``deadline_remaining`` and ``wire_bytes``. A malformed prefix fails
+    typed ``SnapshotInvalid``; a KNOWN-but-foreign version still peeks
+    fine (the refusal decision belongs to the adopting host, which runs
+    the full ``from_bytes`` geometry check)."""
+    if len(blob) < len(_MAGIC) + 6 or not blob.startswith(_MAGIC):
+        raise SnapshotInvalid("not a KVSnapshot byte stream")
+    off = len(_MAGIC)
+    version, hlen = struct.unpack_from("<HI", blob, off)
+    if version not in KVSnapshot.KNOWN_VERSIONS:
+        raise SnapshotInvalid(
+            f"KVSnapshot wire version {version} is unknown to this "
+            "reader")
+    off += 6
+    if hlen > len(blob) - off:
+        raise SnapshotInvalid(
+            f"snapshot header length {hlen} exceeds the {len(blob)}-byte "
+            "blob — truncated or corrupt framing")
+    try:
+        hdr = json.loads(blob[off:off + hlen].decode())
+    except Exception as e:
+        raise SnapshotInvalid(f"unreadable snapshot header: {e}")
+    return {"version": version,
+            "count": hdr.get("count", 0),
+            "pos": hdr.get("pos", 0),
+            "tokens": len(hdr.get("tokens", ())),
+            "deadline_remaining": hdr.get("deadline_remaining"),
+            "wire_bytes": len(blob)}
+
+
 def pack_snapshot(*, req, pos, count, last, key, kv_dtype, page_size,
                   page_token_bytes, page_digests, fetched, n_pages,
                   shards=1,

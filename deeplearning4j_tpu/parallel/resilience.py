@@ -347,7 +347,40 @@ class ChaosPolicy:
       and return without stranding a future.
 
     Both draws are gated on their own non-zero rates, so every legacy
-    seeded sequence (wrap, replica, handoff) stays pinned."""
+    seeded sequence (wrap, replica, handoff) stays pinned.
+
+    Network fault modes (for the cross-host federation drills in
+    ``parallel/federation.py``; injected from the framed-RPC link path
+    via ``net_connect_fault()`` per outbound connect and
+    ``net_fault_mode()`` per frame sent, never from ``wrap()``):
+
+    - ``conn_refused_rate``: the outbound connect attempt raises
+      ``ConnectionRefusedError`` — the host's listener is gone (or a
+      firewall ate the SYN); the router's per-host RetryPolicy and
+      breaker absorb it.
+    - ``partition_rate``/``partition_s``: the link becomes unreachable
+      for a ``partition_s``-second window (monotonic clock) — every
+      send inside the window fails ``TransientDispatchError`` without
+      touching the socket, the CI stand-in for a network partition
+      that heals. ``net_partitioned()`` reports the window state.
+    - ``slow_link_factor``: every frame pays a deterministic
+      serialization delay of ``(factor - 1) x nbytes / 100 MB/s`` — a
+      degraded NIC. No rng draw at all (factor 1.0 = off), so it can
+      never perturb a seeded sequence.
+    - ``frame_corrupt_rate``: one bit of the frame body is flipped
+      after the length prefix is written, so the receiver's framed
+      reader rejects it typed (``FederationProtocolError`` /
+      checksum failure) instead of trusting damaged bytes.
+
+    ``net_connect_fault()`` and ``net_fault_mode()`` each draw from
+    the shared rng only when their own rates are non-zero (the
+    partition/corrupt pair shares one stacked-threshold draw, mutually
+    exclusive per frame like the replica modes), so all legacy fault
+    sequences — wrap, replica, handoff, shutdown — replay pinned."""
+
+    #: nominal healthy link bandwidth the ``slow_link_factor`` delay is
+    #: computed against (bytes/second)
+    LINK_BYTES_PER_S = 100e6
 
     def __init__(self, seed: int = 0, transient_rate: float = 0.0,
                  hard_rate: float = 0.0, latency_s: float = 0.0,
@@ -363,6 +396,11 @@ class ChaosPolicy:
                  kill_during_drain_rate: float = 0.0,
                  stall_sentinel_rate: float = 0.0,
                  stall_sentinel_s: float = 0.0,
+                 conn_refused_rate: float = 0.0,
+                 partition_rate: float = 0.0,
+                 partition_s: float = 0.0,
+                 slow_link_factor: float = 1.0,
+                 frame_corrupt_rate: float = 0.0,
                  sleep: Callable[[float], None] = time.sleep):
         self.transient_rate = float(transient_rate)
         self.hard_rate = float(hard_rate)
@@ -381,6 +419,12 @@ class ChaosPolicy:
         self.kill_during_drain_rate = float(kill_during_drain_rate)
         self.stall_sentinel_rate = float(stall_sentinel_rate)
         self.stall_sentinel_s = float(stall_sentinel_s)
+        self.conn_refused_rate = float(conn_refused_rate)
+        self.partition_rate = float(partition_rate)
+        self.partition_s = float(partition_s)
+        self.slow_link_factor = float(slow_link_factor)
+        self.frame_corrupt_rate = float(frame_corrupt_rate)
+        self._partition_until = 0.0
         self._sleep = sleep
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
@@ -396,6 +440,70 @@ class ChaosPolicy:
         self.injected_handoff_truncate = 0
         self.injected_drain_kill = 0
         self.injected_sentinel_stall = 0
+        self.injected_conn_refused = 0
+        self.injected_partition = 0
+        self.injected_slow_link = 0
+        self.injected_frame_corrupt = 0
+
+    def net_connect_fault(self) -> None:
+        """One seeded draw per outbound connect attempt on a federation
+        link (and only when ``conn_refused_rate`` is non-zero, so every
+        legacy seeded sequence stays pinned). On a hit, raises
+        ``ConnectionRefusedError`` before the socket is touched — the
+        same error a dead listener produces, so the router's retry /
+        breaker / reconnect machinery cannot tell injection from the
+        real thing."""
+        if not self.conn_refused_rate:
+            return
+        with self._lock:
+            hit = self._rng.random() < self.conn_refused_rate
+            if hit:
+                self.injected_conn_refused += 1
+        if hit:
+            raise ConnectionRefusedError(
+                "chaos: connection refused by injected fault")
+
+    def net_partitioned(self) -> bool:
+        """True while the link is inside an injected partition window
+        (armed by a ``net_fault_mode()`` partition hit)."""
+        with self._lock:
+            until = self._partition_until
+        return time.monotonic() < until
+
+    def net_fault_mode(self, nbytes: int = 0) -> Optional[str]:
+        """One seeded draw per frame sent on a federation link, gated
+        on the partition/corrupt rates being non-zero so all legacy
+        sequences replay pinned. The ``slow_link_factor`` delay is
+        deterministic (no draw): ``(factor - 1) x nbytes`` over a
+        nominal 100 MB/s link, applied before the draw. Returns the
+        injected mode — ``"partition"`` (window armed; the caller must
+        fail the send without touching the socket) or ``"corrupt"``
+        (the caller flips one bit of the frame body) — or None. The
+        modes share one stacked-threshold draw, mutually exclusive per
+        frame like the replica modes."""
+        if self.slow_link_factor > 1.0 and nbytes > 0:
+            with self._lock:
+                self.injected_slow_link += 1
+            self._sleep((self.slow_link_factor - 1.0)
+                        * nbytes / self.LINK_BYTES_PER_S)
+        if not (self.partition_rate or self.frame_corrupt_rate):
+            return None
+        with self._lock:
+            r = self._rng.random()
+            t = self.partition_rate
+            part = r < t
+            t += self.frame_corrupt_rate
+            corrupt = not part and r < t
+            if part:
+                self.injected_partition += 1
+                self._partition_until = time.monotonic() + self.partition_s
+            if corrupt:
+                self.injected_frame_corrupt += 1
+        if part:
+            return "partition"
+        if corrupt:
+            return "corrupt"
+        return None
 
     def drain_fault(self) -> None:
         """One seeded draw per item/tick handled while the hosting

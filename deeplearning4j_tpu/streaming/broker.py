@@ -39,7 +39,16 @@ OP_END = b"E"
 OP_SUBSCRIBE = b"S"
 OP_SUB_ACK = b"K"
 
-MAX_FRAME_BYTES = 1 << 30  # defensive bound on payload_len
+MAX_FRAME_BYTES = 1 << 30  # default defensive bound on payload_len
+
+
+class FrameTooLarge(ValueError):
+    """A frame's length prefix declared a payload above the reader's
+    ``max_frame_bytes`` cap. A corrupt (or hostile) 4-byte length must
+    be rejected typed BEFORE any allocation is attempted — trusting it
+    turns one flipped bit into an unbounded ``recv`` buffer. Subclasses
+    ``ValueError`` so pre-existing ``except (OSError, ValueError)``
+    connection handlers keep dropping the poisoned connection."""
 
 
 def read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
@@ -52,8 +61,11 @@ def read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     return buf
 
 
-def read_frame(sock: socket.socket):
-    """(op, topic, payload) or None on clean EOF."""
+def read_frame(sock: socket.socket,
+               max_frame_bytes: int = MAX_FRAME_BYTES):
+    """(op, topic, payload) or None on clean EOF. A length prefix
+    above ``max_frame_bytes`` fails typed ``FrameTooLarge`` — never an
+    attempted allocation of attacker/corruption-controlled size."""
     hdr = read_exact(sock, _HDR.size)
     if hdr is None:
         return None
@@ -65,9 +77,9 @@ def read_frame(sock: socket.socket):
     if raw is None:
         return None
     (plen,) = _LEN.unpack(raw)
-    if plen > MAX_FRAME_BYTES:
-        raise ValueError(f"frame of {plen} bytes exceeds the "
-                         f"{MAX_FRAME_BYTES}-byte bound")
+    if plen > max_frame_bytes:
+        raise FrameTooLarge(f"frame of {plen} bytes exceeds the "
+                            f"{max_frame_bytes}-byte bound")
     payload = read_exact(sock, plen) if plen else b""
     if payload is None:
         return None

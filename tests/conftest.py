@@ -216,6 +216,16 @@ def pytest_configure(config):
         "selection, backend-tagged program caches — CPU-fast; runs in "
         "tier-1, deliberately NOT in the slow set; skips cleanly when "
         "the installed jax cannot interpret Pallas TPU kernels on CPU)")
+    config.addinivalue_line(
+        "markers",
+        "federation: cross-host fleet federation tests (framed host RPC, "
+        "heartbeat gossip suspect detection, whole-process SIGKILL with "
+        "bit-exact cross-host snapshot adoption, partition heal, "
+        "degraded mode). The wire/chaos/shed tests are CPU-fast and run "
+        "in tier-1; the drills that build real fleets or spawn host "
+        "processes are ALSO marked slow — tier-1 already runs within "
+        "~2% of its own timeout cap, so per-drill fleet builds cannot "
+        "ride in it (run them with -m federation)")
 
 
 @pytest.fixture(autouse=True)
@@ -236,7 +246,8 @@ def _lock_order_debug(request):
             or request.node.get_closest_marker("runtime")
             or request.node.get_closest_marker("knn")
             or request.node.get_closest_marker("pallas")
-            or request.node.get_closest_marker("mesh")):
+            or request.node.get_closest_marker("mesh")
+            or request.node.get_closest_marker("federation")):
         yield
         return
     from deeplearning4j_tpu.analysis import instrument
