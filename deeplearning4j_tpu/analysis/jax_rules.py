@@ -72,6 +72,10 @@ HOT_FUNCTIONS = {
     "_gossip_loop",                               # federation router tick
     "_route_host",                                # federation dispatch path
     "_harvest_host",                              # federation crash harvest
+    "_rag_retrieve_done",                         # rag knn-tier completion
+    "_rag_assemble_dispatch",                     # rag tier-boundary route
+    "_rag_generate_done",                         # rag generate completion
+    "_probe_local_rank",                          # per-device IVF probe body
 }
 
 SYNC_BUILTINS = {"float", "bool", "int"}

@@ -141,7 +141,7 @@ class FleetTierTarget:
     depth_key = "queued"
 
     def __init__(self, fleet, role, max_slots=None):
-        if role not in ("prefill", "decode", "unified"):
+        if role not in ("prefill", "decode", "unified", "knn", "generate"):
             raise ValueError(f"unknown tier role {role!r}")
         self._fleet = fleet
         self._role = role

@@ -17,6 +17,9 @@ an HTTP entry point serves any client), batches are ``.npz`` files with
 - POST /generate {"model": id, "prompt_ids": [..], "max_tokens": n,
                   "temperature": t, "top_k": k, "seed": s,
                   "deadline_s": 2.0}                    -> {"tokens": [..]}
+- POST /rag      {"model": id, "prompt_ids": [..], "max_tokens": n,
+                  "query_vec": [..], "k": 4, ...}
+                  -> {"tokens": [..], "docs": [..], "prefix_len": n}
 - GET  /models                                          -> {"models": [..]}
 - GET  /stats                                           -> serving counters
 - GET  /metrics                     -> Prometheus text exposition (0.0.4)
@@ -27,6 +30,14 @@ slot-pooled continuous-batching ``GenerationServer``
 taxonomy: 429 past the admission watermark, 503 while the breaker is
 open, 504 when the per-request deadline expires (queued OR
 mid-generation — the decode slot is freed either way).
+
+/rag serves models registered with ``attach_rag`` through a two-tier
+``RagPipeline`` (parallel/rag.py): the query retrieves top-k passages
+from a knn-tier ``EmbeddingIndex``, the passages assemble into a
+canonical chunk-aligned prefix (hot documents dedupe prefill through
+the generate tier's prefix cache), and the generate tier completes —
+one deadline budget propagated across both tiers, the same
+429/503/504 typing end to end.
 
 The serving path degrades typed instead of failing open
 (parallel/resilience.py): /predict sheds load with 429 past the
@@ -99,6 +110,7 @@ class KerasBackendServer:
         self._models: dict = {}
         self._generators: dict = {}
         self._inference: dict = {}
+        self._rags: dict = {}
         # leaf lock for the /predict server registry: predict() must not
         # touch self._lock before admission (the legacy path holds it for
         # the whole dispatch — the watermark could never 429)
@@ -380,6 +392,90 @@ class KerasBackendServer:
             self._inference[mid] = inf
         return mid
 
+    def attach_rag(self, net, *, vocab: int, passages, doc_vectors,
+                   k: int = 4, slots: int = 4, page_size: int = 16,
+                   pad_id: int = 0, knn_replicas: int = 1,
+                   generate_replicas: int = 1, mid: Optional[str] = None,
+                   encoder=None, index_kw: Optional[dict] = None,
+                   gen_kw: Optional[dict] = None,
+                   rag_kw: Optional[dict] = None) -> str:
+        """Register a causal LM + document corpus for /rag, served by a
+        two-tier ``RagPipeline`` (parallel/rag.py): ``doc_vectors``
+        [N, D] build a knn-tier ``EmbeddingIndex`` per knn replica
+        (``index_kw`` forwards — store=, partitions=, nprobe=, mesh=,
+        ...), ``net`` serves per generate replica through a paged
+        ``GenerationServer`` (``gen_kw`` forwards), and ``passages``
+        maps retrieved doc id -> token ids for the canonical
+        chunk-aligned prefix. ``net`` may be a model instance or an
+        imported model id; returns the id /rag requests should name."""
+        from deeplearning4j_tpu.nearestneighbors.index import EmbeddingIndex
+        from deeplearning4j_tpu.parallel.generation import GenerationServer
+        from deeplearning4j_tpu.parallel.rag import RagPipeline
+
+        with self._lock:
+            if isinstance(net, str):
+                mid = net
+                net = self._net(mid)
+            elif mid is None:
+                mid = f"m{self._next_id}"
+                self._next_id += 1
+            self._models[mid] = net
+            old = self._rags.pop(mid, None)
+        if old is not None:
+            old.close()
+        vecs = np.asarray(doc_vectors, np.float32)
+        ikw = dict(index_kw or {})
+        gkw = dict(gen_kw or {})
+        gkw.setdefault("page_size", page_size)
+        gkw.setdefault("role", "generate")
+
+        def knn_factory(rid):
+            return EmbeddingIndex(vecs, **ikw)
+
+        def gen_factory(rid):
+            return GenerationServer(net, vocab, slots=slots, **gkw)
+
+        pipe = RagPipeline(knn_factory, gen_factory, passages,
+                           page_size=page_size, pad_id=pad_id, k=k,
+                           encoder=encoder, knn_replicas=knn_replicas,
+                           generate_replicas=generate_replicas,
+                           **(rag_kw or {}))
+        with self._lock:
+            self._rags[mid] = pipe
+        return mid
+
+    def rag(self, mid: str, prompt_ids, max_tokens: int,
+            query_vec=None, k: Optional[int] = None,
+            temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+            deadline_s: Optional[float] = None) -> dict:
+        """Submit one retrieval-augmented request and wait for its
+        tokens + retrieval metadata. The pipeline enforces admission/
+        deadline/breaker typing across both tiers; the handler maps it
+        onto 429/503/504 exactly like /generate."""
+        with self._lock:
+            pipe = self._rags.get(mid)
+        if pipe is None:
+            raise UnknownModelError(
+                f"unknown rag model '{mid}' — register it with "
+                "attach_rag()")
+        budget = deadline_s if deadline_s is not None \
+            else self.request_deadline_s
+        fut = pipe.submit(np.asarray(prompt_ids, np.int64),
+                          int(max_tokens), query_vec=query_vec, k=k,
+                          temperature=float(temperature),
+                          top_k=int(top_k), seed=int(seed),
+                          deadline_s=budget)
+        try:
+            out = fut.result(timeout=None if budget is None
+                             else budget + 30.0)
+        except Exception:
+            self._m_failed.inc()
+            raise
+        self._m_completed.inc()
+        return {"tokens": np.asarray(out).tolist(),
+                "docs": [int(d) for d in fut._rag_docs],
+                "prefix_len": int(fut._rag_prefix_len)}
+
     def generate(self, mid: str, prompt_ids, max_tokens: int,
                  temperature: float = 0.0, top_k: int = 0, seed: int = 0,
                  deadline_s: Optional[float] = None) -> list:
@@ -430,6 +526,7 @@ class KerasBackendServer:
         with self._lock:
             out["models"] = len(self._models)
             gens = dict(self._generators)
+            rags = dict(self._rags)
         with self._inference_lock:
             infs = dict(self._inference)
         if gens:
@@ -453,6 +550,10 @@ class KerasBackendServer:
                 out["handoff"] = handoff
         if infs:
             out["inference"] = {mid: i.stats() for mid, i in infs.items()}
+        if rags:
+            # two-tier RAG ledgers: per-model submitted/completed/...,
+            # headline prefix-dedupe counters, per-tier aggregates
+            out["rag"] = {mid: r.stats() for mid, r in rags.items()}
         return out
 
     def register_metrics(self, labels: Optional[dict],
@@ -473,12 +574,14 @@ class KerasBackendServer:
         once — first labeling wins."""
         with self._lock:
             gens = dict(self._generators)
+            rags = dict(self._rags)
             extras = list(self._extra_metrics)
         with self._inference_lock:
             infs = dict(self._inference)
         sources = [({}, self.metrics)]
         seen = {id(self.metrics)}
-        for mid, target in list(gens.items()) + list(infs.items()):
+        for mid, target in (list(gens.items()) + list(infs.items())
+                            + list(rags.items())):
             # a federated target exposes one source per remote host
             # (injected host= label alongside model=) via
             # metrics_sources(); plain targets expose one registry
@@ -587,6 +690,16 @@ class KerasBackendServer:
                             int(req.get("top_k", 0)),
                             int(req.get("seed", 0)),
                             req.get("deadline_s"))})
+                    elif self.path == "/rag":
+                        self._json(server.rag(
+                            req["model"], req["prompt_ids"],
+                            int(req["max_tokens"]),
+                            req.get("query_vec"),
+                            req.get("k"),
+                            float(req.get("temperature", 0.0)),
+                            int(req.get("top_k", 0)),
+                            int(req.get("seed", 0)),
+                            req.get("deadline_s")))
                     else:
                         self._error(404, "not found", "NotFound")
                 except UnknownModelError as e:
@@ -615,6 +728,8 @@ class KerasBackendServer:
         with self._lock:
             gens = list(self._generators.values())
             self._generators.clear()
+            gens.extend(self._rags.values())
+            self._rags.clear()
         with self._inference_lock:
             gens.extend(self._inference.values())
             self._inference.clear()

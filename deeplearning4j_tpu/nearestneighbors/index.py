@@ -20,7 +20,18 @@ The reference retrieval stack is a host VPTree behind a Play REST server
 * **IVF**: a partitioned variant for the 10M+-vector regime — k-means
   centroids (clustering/), an nprobe-limited candidate gather, and an
   exact re-rank of the gathered candidates, recall-gated ≥0.95 vs exact
-  in tests and the ``knn_serve`` bench.
+  in tests and the ``knn_serve`` bench. With a mesh the centroids train
+  SHARDED (per-device assign sweeps, GSPMD all-reduce centroid updates)
+  and the cells become device-RESIDENT: each device probes its own
+  local cells and gathers candidates locally (``_probe_local_rank``
+  under ``shard_map``), so a 10M-vector int8 store splits across the
+  mesh and a query moves k candidates per device — never a cell list —
+  over ICI.
+* **HNSW**: ``store="hnsw"`` swaps in a graph index (the reference's
+  ``clustering/vptree`` lineage, navigable-small-world form): greedy
+  descent through geometric levels + an ef-bounded beam at layer 0,
+  host-resident, behind the identical ``submit()``/coalescer surface
+  with recall as a first-class gauge.
 * **Serve**: ``submit() -> Future`` queries flow through a background
   coalescer (``ServingLoop``) mirroring ParallelInference's: N one-row
   submits become ONE fused matmul+top_k dispatch, bucketed pow2 on both
@@ -57,7 +68,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from deeplearning4j_tpu.metrics.registry import MetricsRegistry
 from deeplearning4j_tpu.nearestneighbors.brute import _knn
 from deeplearning4j_tpu.optimize.bucketing import BoundedCache
-from deeplearning4j_tpu.parallel.mesh import DATA_AXIS
+from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, shard_map_compat
 from deeplearning4j_tpu.parallel.resilience import (AdmissionController,
                                                     ChaosPolicy,
                                                     CircuitBreaker,
@@ -174,6 +185,128 @@ def _assign_chunk(x, centroids):
     return jnp.argmin(d2, axis=1).astype(jnp.int32)
 
 
+@jax.jit
+def _kmeans_step(x, centroids):
+    """One sharded Lloyd iteration: per-device nearest-centroid
+    assignment, per-device partial sums, all-reduce centroid update.
+    ``x`` arrives committed P(data, None) and ``centroids`` replicated,
+    so GSPMD partitions the assign matmul and the ``oh.T @ x`` /
+    count reductions over the mesh and inserts the all-reduce — the
+    10M-row assign sweep never leaves its device. Empty clusters keep
+    their previous centroid. Returns (new centroids, max shift)."""
+    xn = jnp.sum(x * x, axis=1, keepdims=True)
+    c2 = jnp.sum(centroids * centroids, axis=1)
+    d2 = xn - 2.0 * (x @ centroids.T) + c2[None, :]
+    assign = jnp.argmin(d2, axis=1)
+    oh = jax.nn.one_hot(assign, centroids.shape[0], dtype=x.dtype)
+    sums = oh.T @ x                          # [C, D] partial -> all-reduce
+    cnts = jnp.sum(oh, axis=0)               # [C]
+    newc = jnp.where(cnts[:, None] > 0.5,
+                     sums / jnp.maximum(cnts, 1.0)[:, None], centroids)
+    return newc, jnp.max(jnp.abs(newc - centroids))
+
+
+def _probe_local_rank(centroids, cbias, vecs, scales, laux, ids, q, qn,
+                      *, k: int, nprobe: int, metric: str):
+    """Per-device IVF probe + gather + re-rank (the ``shard_map`` body;
+    on the graftcheck hot list — pure jnp, no host syncs). Every operand
+    except the replicated query block is this device's shard: probe the
+    ``min(nprobe, local cells)`` nearest LOCAL cells, gather their
+    vectors locally (no cross-device cell movement), exact re-rank to
+    the local top-k, and pad to k with +inf/-1 so the caller's one
+    on-device merge over the [Q, devices*k] concatenation is exact.
+    Distances stay squared for euclidean — the merge applies the sqrt.
+
+    Recall dominates the global-probe kernel's: any cell in the global
+    top-``nprobe`` is in its home device's local top-``nprobe``, so the
+    union candidate pool is a superset of the global pool."""
+    Qn = q.shape[0]
+    p = min(nprobe, centroids.shape[0])
+    c2 = jnp.sum(centroids * centroids, axis=1)
+    cd = qn - 2.0 * (q @ centroids.T) + c2[None, :] + cbias[None, :]
+    _, probes = jax.lax.top_k(-cd, p)                        # [Q, p] local
+    cand = jnp.take(vecs, probes, axis=0)                    # [Q, p, M, D]
+    aux = jnp.take(laux, probes, axis=0).reshape(Qn, -1)
+    gids = jnp.take(ids, probes, axis=0).reshape(Qn, -1)
+    M = cand.shape[1] * cand.shape[2]
+    flat = cand.reshape(Qn, M, -1).astype(q.dtype)
+    dots = jnp.einsum("qd,qmd->qm", q, flat)
+    if scales is not None:
+        dots = dots * jnp.take(scales, probes, axis=0).reshape(Qn, M)
+    if metric == "cosine":
+        dists = jnp.maximum(1.0 - dots, 0.0) + aux
+    else:
+        dists = jnp.maximum(qn - 2.0 * dots + aux, 0.0)
+    kk = min(k, M)
+    neg, loc = jax.lax.top_k(-dists, kk)
+    d = -neg
+    lids = jnp.take_along_axis(gids, loc, axis=1)
+    if kk < k:
+        d = jnp.concatenate(
+            [d, jnp.full((Qn, k - kk), jnp.inf, d.dtype)], axis=1)
+        lids = jnp.concatenate(
+            [lids, jnp.full((Qn, k - kk), -1, lids.dtype)], axis=1)
+    return d, lids
+
+
+def _make_probe_local(mesh, metric: str, quantized: bool):
+    """Build the jitted probe-local IVF search for one (mesh, metric,
+    store kind): ``shard_map`` over the cell axis with each device
+    contributing its local top-k, merged by ONE on-device top_k over
+    the [Q, devices*k] concatenation. Module-level + cached so store
+    rebuilds (bulk adds) reuse the compiled programs — zero retrace."""
+    store_specs = [P(DATA_AXIS, None), P(DATA_AXIS),
+                   P(DATA_AXIS, None, None)]
+    if quantized:
+        store_specs.append(P(DATA_AXIS, None))               # scales
+    store_specs += [P(DATA_AXIS, None), P(DATA_AXIS, None)]  # laux, ids
+    in_specs = tuple(store_specs) + (P(None, None), P(None, None))
+    out_specs = (P(None, DATA_AXIS), P(None, DATA_AXIS))
+
+    @partial(jax.jit, static_argnames=("k", "nprobe"))
+    def search(arrays, queries, *, k: int, nprobe: int):
+        Qn = queries.shape[0]
+        if metric == "cosine":
+            q = queries / jnp.maximum(
+                jnp.linalg.norm(queries, axis=1, keepdims=True), 1e-12)
+            qn = jnp.ones((Qn, 1), queries.dtype)
+        else:
+            q = queries
+            qn = jnp.sum(q * q, axis=1, keepdims=True)
+
+        def body(*ops):
+            if quantized:
+                c, cb, v, s, la, ii, qq, qqn = ops
+            else:
+                (c, cb, v, la, ii, qq, qqn), s = ops, None
+            return _probe_local_rank(c, cb, v, s, la, ii, qq, qqn,
+                                     k=k, nprobe=nprobe, metric=metric)
+
+        sm = shard_map_compat(body, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs)
+        d, ii = sm(*(tuple(arrays) + (q, qn)))   # [Q, devices*k] each
+        neg, loc = jax.lax.top_k(-d, k)
+        dd = -neg
+        idx = jnp.take_along_axis(ii, loc, axis=1)
+        if metric != "cosine":
+            dd = jnp.sqrt(dd)
+        return dd, idx
+
+    return search
+
+
+_PROBE_LOCAL_CACHE: dict = {}
+
+
+def _probe_local_searcher(mesh, metric: str, quantized: bool):
+    key = (mesh, metric, quantized)
+    fn = _PROBE_LOCAL_CACHE.get(key)
+    if fn is None:
+        fn = _PROBE_LOCAL_CACHE[key] = _make_probe_local(
+            mesh, metric, quantized)
+    return fn
+
+
 # --------------------------------------------------------------------------
 # store construction (host-side, deterministic)
 # --------------------------------------------------------------------------
@@ -200,11 +333,12 @@ class _Store:
     read a coherent store lock-free (EmbeddingIndex._LOOP_OWNED)."""
 
     __slots__ = ("variant", "n", "dim", "arrays", "nprobe", "n_lists",
-                 "list_len", "spilled", "resident_bytes")
+                 "list_len", "spilled", "resident_bytes", "searcher",
+                 "graph")
 
     def __init__(self, variant, n, dim, arrays, nprobe=0, n_lists=0,
-                 list_len=0, spilled=0):
-        self.variant = variant      # exact | aux | int8 | ivf
+                 list_len=0, spilled=0, searcher=None, graph=None):
+        self.variant = variant      # exact | aux | int8 | ivf | hnsw
         self.n = n
         self.dim = dim
         self.arrays = arrays
@@ -212,8 +346,12 @@ class _Store:
         self.n_lists = n_lists
         self.list_len = list_len
         self.spilled = spilled
+        self.searcher = searcher    # probe-local jitted search (mesh IVF)
+        self.graph = graph          # HNSWGraph (store="hnsw")
         self.resident_bytes = sum(int(a.nbytes) for a in arrays
                                   if a is not None)
+        if graph is not None:
+            self.resident_bytes += graph.nbytes
 
 
 class _QueryRequest:
@@ -263,7 +401,9 @@ class EmbeddingIndex:
                  store: str = "f32", encoder=None, mesh=None,
                  partitions: Optional[int] = None, nprobe: int = 8,
                  list_cap: Optional[int] = None, train_sample: int = 65536,
-                 kmeans_iters: int = 25, seed: int = 0,
+                 kmeans_iters: int = 25, kmeans: str = "auto",
+                 hnsw_m: int = 16, ef_construction: int = 64,
+                 ef_search: int = 64, seed: int = 0,
                  max_batch: int = 64, max_wait_ms: float = 2.0,
                  inflight: int = 2, max_pending: int = 256,
                  retry: Optional[RetryPolicy] = None,
@@ -273,20 +413,41 @@ class EmbeddingIndex:
                  default_k: int = 10):
         if metric not in ("euclidean", "cosine"):
             raise ValueError(f"metric must be euclidean|cosine, got {metric}")
-        if store not in ("f32", "int8"):
-            raise ValueError(f"store must be f32|int8, got {store}")
+        if store not in ("f32", "int8", "hnsw"):
+            raise ValueError(f"store must be f32|int8|hnsw, got {store}")
+        if kmeans not in ("auto", "host", "sharded"):
+            raise ValueError(
+                f"kmeans must be auto|host|sharded, got {kmeans}")
+        if kmeans == "sharded" and mesh is None:
+            raise ValueError("kmeans='sharded' requires a mesh")
+        if store == "hnsw" and (mesh is not None or partitions is not None):
+            raise ValueError("store='hnsw' is host-resident: it composes "
+                             "with neither mesh= nor partitions=")
+        nprobe = int(nprobe)
+        if nprobe < 1:
+            raise ValueError(f"nprobe must be >= 1, got {nprobe}")
         self.metric = metric
         self.store_kind = store
         self.encoder = encoder
         self.mesh = mesh
         self.partitions = None if partitions is None else int(partitions)
-        self.nprobe = max(1, int(nprobe))
+        # over-probing beyond the partition count clamps at build time
+        # (nprobe = min(nprobe, C)); under-probing below 1 is the typed
+        # ValueError above
+        self.nprobe = nprobe
         self.list_cap = list_cap
         self.train_sample = int(train_sample)
         self.kmeans_iters = int(kmeans_iters)
+        self.kmeans = kmeans
+        self.hnsw_m = int(hnsw_m)
+        self.ef_construction = int(ef_construction)
+        self.ef_search = int(ef_search)
         self.seed = int(seed)
         self.default_k = int(default_k)
         self.max_batch = int(max_batch)
+        # the lever's fixed ceiling ("slots" in the tier_stats surface,
+        # mirroring GenerationServer's compiled slot pool)
+        self.max_batch_pool = self.max_batch
         self.max_wait_s = float(max_wait_ms) / 1e3
         self.inflight = max(1, int(inflight))
         self.admission = AdmissionController(max_pending)
@@ -371,6 +532,21 @@ class EmbeddingIndex:
     def dispatch_count(self) -> int:
         return int(self._m_dispatches.value)
 
+    # ------------------------------------------------- autoscaler lever
+    @property
+    def active_slot_cap(self) -> int:
+        """GenerationServer duck-type for FleetTierTarget: the knn
+        tier's capacity knob is the coalescer's row cap."""
+        return self.max_batch
+
+    def set_active_slots(self, n: int) -> int:
+        """Autoscaler lever (GenerationServer duck-type): moves the
+        coalescer's ``max_batch`` row cap within [1, construction-time
+        pool]. Bigger batches amortize the dispatch under load; smaller
+        ones bound per-query latency."""
+        self.max_batch = max(1, min(int(n), self.max_batch_pool))
+        return self.max_batch
+
     # -------------------------------------------------------------- encode
     def encode(self, docs) -> np.ndarray:
         """Batch-encode documents into [N, D] f32 vectors through the
@@ -438,9 +614,20 @@ class EmbeddingIndex:
             nrm = np.maximum(
                 np.linalg.norm(pts, axis=1, keepdims=True), 1e-12)
             pts = (pts / nrm).astype(np.float32)
+        if self.store_kind == "hnsw":
+            return self._build_hnsw(pts)
         if self.partitions is not None:
             return self._build_ivf(pts)
         return self._build_flat(pts)
+
+    def _build_hnsw(self, pts: np.ndarray) -> _Store:
+        from deeplearning4j_tpu.nearestneighbors.hnsw import HNSWGraph
+
+        n, d = pts.shape
+        graph = HNSWGraph(pts, metric=self.metric, m=self.hnsw_m,
+                          ef_construction=self.ef_construction,
+                          seed=self.seed)
+        return _Store("hnsw", n, d, (), graph=graph)
 
     def _put(self, a, spec=None):
         """Upload one store array, sharded over the points axis when a
@@ -486,6 +673,33 @@ class EmbeddingIndex:
                           (self._put(q), self._put(scale), self._put(aux)))
         return _Store("aux", n, d, (self._put(padded), self._put(aux)))
 
+    def _kmeans_sharded(self, sample: np.ndarray, C: int) -> np.ndarray:
+        """Mesh-sharded centroid training: the training rows are
+        committed P(data, None) and every Lloyd iteration is ONE
+        ``_kmeans_step`` program — per-device assign sweep, GSPMD
+        all-reduce centroid update. Deterministic init from ``seed``
+        (real rows, never pad), host-synced shift test per iteration
+        (build path, not serving). Row padding REPEATS real rows so the
+        pad can never mint a phantom centroid."""
+        n, d = sample.shape
+        m = int(self.mesh.devices.size)
+        npad = -(-n // m) * m
+        if npad != n:
+            sample = np.concatenate(
+                [sample, sample[np.resize(np.arange(n), npad - n)]])
+        rng = np.random.RandomState(self.seed)
+        centroids = sample[rng.choice(n, C, replace=n < C)]
+        xd = jax.device_put(
+            sample, NamedSharding(self.mesh, P(DATA_AXIS, None)))
+        cd = jax.device_put(
+            np.ascontiguousarray(centroids, np.float32),
+            NamedSharding(self.mesh, P(None, None)))
+        for _ in range(self.kmeans_iters):
+            cd, shift = _kmeans_step(xd, cd)
+            if float(shift) <= 1e-6:
+                break
+        return np.asarray(cd, np.float32)
+
     def _build_ivf(self, pts: np.ndarray) -> _Store:
         from deeplearning4j_tpu.clustering.kmeans import KMeansClustering
 
@@ -494,10 +708,15 @@ class EmbeddingIndex:
         rng = np.random.RandomState(self.seed)
         t = min(self.train_sample, n)
         sample = pts if t == n else pts[rng.choice(n, t, replace=False)]
-        km = KMeansClustering(C, max_iterations=self.kmeans_iters,
-                              seed=self.seed)
-        km.apply_to(sample)
-        centroids = np.asarray(km.centers, np.float32)
+        sharded = self.kmeans == "sharded" or (
+            self.kmeans == "auto" and self.mesh is not None)
+        if sharded and self.mesh is not None:
+            centroids = self._kmeans_sharded(sample, C)
+        else:
+            km = KMeansClustering(C, max_iterations=self.kmeans_iters,
+                                  seed=self.seed)
+            km.apply_to(sample)
+            centroids = np.asarray(km.centers, np.float32)
         # chunked device assignment: fixed pow2 chunk so the sweep is one
         # program regardless of N
         CH = min(65536, _pow2(n))
@@ -516,48 +735,62 @@ class EmbeddingIndex:
             M = min(M, _pow2(self.list_cap))
         spilled = int(np.maximum(counts - M, 0).sum())
         order = np.argsort(assign, kind="stable")
-        ids = np.full((C, M), -1, np.int32)
-        vecs = np.zeros((C, M, d), np.float32)
-        pos = 0
-        for c in range(C):
-            take = order[pos:pos + counts[c]][:M]
-            pos += counts[c]
-            ids[c, :len(take)] = take
-            vecs[c, :len(take)] = pts[take]
         # pad C to the mesh multiple with +inf-biased empty lists
         Cpad = C
         if self.mesh is not None:
             m = int(self.mesh.devices.size)
             Cpad = -(-C // m) * m
+        quant = self.store_kind == "int8"
+        ids = np.full((Cpad, M), -1, np.int32)
+        if quant:
+            # memory-lean 10M-point build: quantize cell by cell straight
+            # into the preallocated int8 store — the f32 [C*M, D] copy and
+            # its dequant transient never exist (peak extra = one cell)
+            qvecs = np.zeros((Cpad, M, d), np.int8)
+            scl = np.zeros((Cpad, M), np.float32)
+            lsq = np.zeros((Cpad, M), np.float32)
+        else:
+            vecs = np.zeros((Cpad, M, d), np.float32)
+        pos = 0
+        for c in range(C):
+            take = order[pos:pos + counts[c]][:M]
+            pos += counts[c]
+            ids[c, :len(take)] = take
+            if len(take) == 0:
+                continue
+            if quant:
+                qr, sr = _quantize_rows(pts[take])
+                deq = qr.astype(np.float32) * sr[:, None]
+                qvecs[c, :len(take)] = qr
+                scl[c, :len(take)] = sr
+                lsq[c, :len(take)] = np.sum(deq * deq, axis=1)
+            else:
+                vecs[c, :len(take)] = pts[take]
         if Cpad != C:
             centroids = np.concatenate(
                 [centroids, np.zeros((Cpad - C, d), np.float32)])
-            ids = np.concatenate([ids, np.full((Cpad - C, M), -1, np.int32)])
-            vecs = np.concatenate([vecs, np.zeros((Cpad - C, M, d),
-                                                  np.float32)])
         cbias = np.zeros(Cpad, np.float32)
         cbias[C:] = np.inf
-        flat = vecs.reshape(Cpad * M, d)
         scales = None
-        if self.store_kind == "int8":
-            qrows, srows = _quantize_rows(flat)
-            deq = qrows.astype(np.float32) * srows[:, None]
-            lsq = np.sum(deq * deq, axis=1)
-            vdev = self._put(qrows.reshape(Cpad, M, d))
-            scales = self._put(srows.reshape(Cpad, M).astype(np.float32))
+        if quant:
+            vdev = self._put(qvecs)
+            scales = self._put(scl)
         else:
-            lsq = np.sum(flat * flat, axis=1)
+            lsq = np.sum(vecs * vecs, axis=2)
             vdev = self._put(vecs)
         if self.metric == "cosine":
             laux = np.zeros((Cpad, M), np.float32)
         else:
-            laux = lsq.reshape(Cpad, M).astype(np.float32)
+            laux = lsq.astype(np.float32)
         laux[ids < 0] = np.inf   # empty slots (and pad lists) never win
         nprobe = min(self.nprobe, C)
+        searcher = None if self.mesh is None else _probe_local_searcher(
+            self.mesh, self.metric, quant)
         return _Store("ivf", n, d,
                       (self._put(centroids), self._put(cbias), vdev, scales,
                        self._put(laux), self._put(ids)),
-                      nprobe=nprobe, n_lists=C, list_len=M, spilled=spilled)
+                      nprobe=nprobe, n_lists=C, list_len=M, spilled=spilled,
+                      searcher=searcher)
 
     # ------------------------------------------------------------ dispatch
     def _bucket_kb(self, k: int, st: _Store) -> int:
@@ -612,12 +845,26 @@ class EmbeddingIndex:
             qpts, scales, aux = st.arrays
             self._record_program(("int8", bucket, kb))
             out = _knn_int8(qpts, scales, aux, qd, k=kb, metric=self.metric)
+        elif st.variant == "hnsw":
+            # host graph walk: returns numpy, so the completer's "fetch"
+            # is a no-op copy — no device program, but the same bucketed
+            # signature keys the ledger
+            self._record_program(("hnsw", bucket, kb))
+            out = st.graph.search_batch(x, kb, ef=self.ef_search)
         else:
             centroids, cbias, vecs, scales, laux, ids = st.arrays
             nprobe = min(max(st.nprobe, -(-kb // st.list_len)), st.n_lists)
-            self._record_program(("ivf", bucket, kb, nprobe))
-            out = _knn_ivf(centroids, cbias, vecs, scales, laux, ids, qd,
-                           k=kb, nprobe=nprobe, metric=self.metric)
+            if st.searcher is not None:
+                # probe-local mesh path: per-device cells, per-device
+                # gathers, one cross-device top-k merge
+                self._record_program(("ivf_local", bucket, kb, nprobe))
+                out = st.searcher(
+                    tuple(a for a in st.arrays if a is not None), qd,
+                    k=kb, nprobe=nprobe)
+            else:
+                self._record_program(("ivf", bucket, kb, nprobe))
+                out = _knn_ivf(centroids, cbias, vecs, scales, laux, ids,
+                               qd, k=kb, nprobe=nprobe, metric=self.metric)
         self._m_dispatches.inc()
         return out
 
@@ -937,13 +1184,21 @@ class EmbeddingIndex:
                "recall": float(self._m_recall.value)}
         if st is not None and st.variant == "ivf":
             out.update(partitions=st.n_lists, list_len=st.list_len,
-                       nprobe=st.nprobe, spilled=st.spilled)
+                       nprobe=st.nprobe, spilled=st.spilled,
+                       probe_local=st.searcher is not None)
+        if st is not None and st.variant == "hnsw":
+            out.update(hnsw_m=st.graph.m, ef_search=self.ef_search,
+                       levels=st.graph.levels)
         out.update(
             accepted=self.admission.accepted,
             rejected=self.admission.rejected,
             pending=self.admission.pending,
             breaker_state=(self.breaker.state if self.breaker is not None
-                           else "disabled"))
+                           else "disabled"),
+            # fleet tier_stats surface (FleetTierTarget's observation
+            # keys): queue depth + the capacity lever's pool size
+            queued=self.admission.pending,
+            slots=self.max_batch_pool)
         return out
 
     def drain(self, timeout: Optional[float] = None) -> bool:

@@ -181,14 +181,16 @@ class _FleetRequest:
 
     __slots__ = ("args", "kwargs", "deadline", "future", "resolved",
                  "active", "tried", "attempts", "hedges", "t_dispatch",
-                 "last_error", "snapshot", "t_submit", "t_first")
+                 "last_error", "snapshot", "t_submit", "t_first", "tier")
 
     def __init__(self, args: tuple, kwargs: dict,
-                 deadline: Optional[Deadline], future: Future):
+                 deadline: Optional[Deadline], future: Future,
+                 tier: Optional[str] = None):
         self.args = args
         self.kwargs = kwargs
         self.deadline = deadline
         self.future = future
+        self.tier = tier  # role-pinned routing (RAG knn/generate tiers)
         self.t_submit = time.monotonic()
         self.t_first = 0.0  # when the fleet first saw a token (TTFT)
         self.resolved = False
@@ -245,10 +247,11 @@ class ReplicaFleet:
                     f"roles must name one role per replica "
                     f"({int(replicas)}), got {len(roles)}")
             bad = sorted({x for x in roles
-                          if x not in ("unified", "prefill", "decode")})
+                          if x not in ("unified", "prefill", "decode",
+                                       "knn", "generate")})
             if bad:
                 raise ValueError(f"unknown replica roles {bad!r}")
-            if any(x != "unified" for x in roles):
+            if any(x in ("prefill", "decode") for x in roles):
                 if not any(x in ("prefill", "unified") for x in roles):
                     raise ValueError("a tiered fleet needs at least one "
                                      "prefill-capable replica")
@@ -356,6 +359,12 @@ class ReplicaFleet:
                 warmup(server)
             self._replicas.append(self._new_replica(rid, 0, server))
         self._tiered = any(r.role != "unified" for r in self._replicas)
+        # staged prefill->decode pipeline semantics (KVSnapshot staging,
+        # degraded mode, colocated fallback) apply only to the disagg
+        # roles; role-pinned tiers ("knn"/"generate" — the RAG pipeline)
+        # route by submit(tier=...) and resolve directly
+        self._staged = any(r.role in ("prefill", "decode")
+                           for r in self._replicas)
 
         self._runtime = ServingLoop("fleet-monitor",
                                     tick=self._monitor_tick,
@@ -411,23 +420,34 @@ class ReplicaFleet:
             return len(self._replicas)
 
     def submit(self, *args, deadline_s: Optional[float] = None,
-               **kwargs) -> Future:
+               tier: Optional[str] = None, **kwargs) -> Future:
         """Route one request to the healthiest replica. Returns a Future
         that resolves with the replica's result, survives replica death
         via re-dispatch, and fails only with a typed error. Raises
         ``ServerOverloaded`` / ``CircuitOpen`` / ``ReplicaUnavailable``
-        synchronously when the fleet cannot accept the request."""
+        synchronously when the fleet cannot accept the request.
+
+        ``tier`` pins the request to replicas of one role (exact match
+        — the RAG pipeline routes retrieval to its ``"knn"`` tier and
+        generation to its ``"generate"`` tier this way). A pinned
+        request never falls back cross-tier: with no READY replica in
+        the tier it sheds ``ReplicaUnavailable`` (fresh submit) or
+        parks for re-dispatch (accepted work)."""
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError("deadline_s must be positive")
         with self._cond:
             if self._closing:
                 raise RuntimeError("ReplicaFleet is closed")
+            if tier is not None and not any(r.role == tier
+                                            for r in self._replicas):
+                raise ValueError(f"no replica fills tier {tier!r}")
         self.admission.acquire()  # fleet-wide high-watermark (429)
         fut = Future()
         fut.add_done_callback(lambda _f: self.admission.release())
         freq = _FleetRequest(
             args, kwargs,
-            None if deadline_s is None else Deadline(deadline_s), fut)
+            None if deadline_s is None else Deadline(deadline_s), fut,
+            tier=tier)
         with self._cond:
             self._inflight_reqs.add(freq)
         self._m_submitted.inc()
@@ -743,7 +763,12 @@ class ReplicaFleet:
         ready = [r for r in self._replicas
                  if r.state == READY and r.rid not in skip
                  and r.rid not in freq.active]
-        if not self._tiered:
+        if freq.tier is not None:
+            # role-pinned request (RAG tier route): exact-role match
+            # only, no cross-tier fallback — a knn query cannot run on
+            # a generation replica
+            return [r for r in ready if r.role == freq.tier], False
+        if not self._staged:
             return ready, False
         stage2 = freq.snapshot is not None
         want = ("decode", "unified") if stage2 else ("prefill", "unified")
@@ -845,7 +870,7 @@ class ReplicaFleet:
             # don't export snapshots nobody can adopt) and staged
             # snapshots adopt in place (adoption always decodes to
             # completion)
-            colocated = (self._tiered and rep.role == "prefill"
+            colocated = (self._staged and rep.role == "prefill"
                          and (colocate or snap is not None))
             inner = None
             if snap is not None and hasattr(rep.server, "adopt_request"):
@@ -981,7 +1006,7 @@ class ReplicaFleet:
         if exc is None:
             rep.breaker.record_success()
             result = fut.result()
-            if self._tiered and isinstance(result, KVSnapshot):
+            if self._staged and isinstance(result, KVSnapshot):
                 # stage 1 of the tier pipeline complete: the prefill
                 # replica exported the request as a snapshot — stage it
                 # for the decode tier instead of resolving the caller
@@ -1097,6 +1122,11 @@ class ReplicaFleet:
             loser.cancel()  # queued attempts die; running ones are ignored
         try:
             if exc is None:
+                if freq.t_first:
+                    # TTFT stamp rides the caller future (same contract
+                    # as the replica servers'), so a pipeline stacked on
+                    # the fleet — RAG — can observe end-to-end TTFT
+                    freq.future._t_first = freq.t_first
                 freq.future.set_result(value)
             else:
                 # the newest harvested snapshot rides the failed future
@@ -1139,7 +1169,7 @@ class ReplicaFleet:
                     if r.state == DEAD and r.restart_at <= now:
                         r.state = SPAWNING
                         spawn.append(r.rid)
-            if self._tiered and self._degraded and any(
+            if self._staged and self._degraded and any(
                     r.state == READY
                     and r.role in ("decode", "unified")
                     for r in self._replicas):

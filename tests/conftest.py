@@ -131,6 +131,21 @@ def _output_recompile_guard(request):
         "allow_output_recompiles if the shapes are genuinely diverse")
 
 
+# Tier-1 duration budget (pinned 2026-08-07, PR 18): the `-m 'not slow'`
+# suite measured 938s against its own 870s timeout cap on the single-core
+# CI box (845 passed, `--durations=25`). To restore >=5% headroom
+# (<=826s), the heaviest compile-bound entries moved to `slow`, chosen so
+# every code path keeps a cheaper tier-1 sibling:
+#   test_zoo big-model params InceptionResNetV1 (23.5s), GoogLeNet
+#     (20.6s), ResNet50 (15.2s) — AlexNet/VGG16/VGG19/FaceNet still run;
+#   test_zoo small-model param SimpleCNN (17.7s) — LeNet + LSTM still run;
+#   test_examples lenet_mesh_dataparallel.py (19.9s),
+#     transformer_text_generation.py (12.8s), keras_residual_import.py
+#     (11.4s) — each subsystem has a dedicated tier-1 module.
+# ~121s moved -> ~818s estimated. Every NEW test that builds a fleet or
+# trains an index must be marked slow (see the federation/rag marker
+# descriptions below); re-run with --durations=25 before adding anything
+# >5s to tier-1.
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running test")
     config.addinivalue_line(
@@ -226,6 +241,15 @@ def pytest_configure(config):
         "processes are ALSO marked slow — tier-1 already runs within "
         "~2% of its own timeout cap, so per-drill fleet builds cannot "
         "ride in it (run them with -m federation)")
+    config.addinivalue_line(
+        "markers",
+        "rag: retrieval-augmented serving tests (two-tier knn->generate "
+        "RagPipeline, canonical passage-prefix assembly, prefix-cache "
+        "dedupe across hot documents, deadline propagation across the "
+        "tier boundary, /rag HTTP route). The unit/parity tests are "
+        "CPU-fast and run in tier-1; the drills that build fleets or "
+        "train sharded k-means are ALSO marked slow — tier-1 runs "
+        "within ~2% of its own timeout cap (run them with -m rag)")
 
 
 @pytest.fixture(autouse=True)
@@ -247,7 +271,8 @@ def _lock_order_debug(request):
             or request.node.get_closest_marker("knn")
             or request.node.get_closest_marker("pallas")
             or request.node.get_closest_marker("mesh")
-            or request.node.get_closest_marker("federation")):
+            or request.node.get_closest_marker("federation")
+            or request.node.get_closest_marker("rag")):
         yield
         return
     from deeplearning4j_tpu.analysis import instrument

@@ -271,6 +271,127 @@ class TestMeshSharded:
 
 
 # ---------------------------------------------------------------------------
+# nprobe contract: typed under-probing, clamped over-probing
+# ---------------------------------------------------------------------------
+
+class TestNprobeContract:
+    def test_nprobe_below_one_typed(self):
+        """Silent fallback was the old behavior; under-probing is now a
+        caller error (typed before any store builds)."""
+        for bad in (0, -3):
+            with pytest.raises(ValueError, match="nprobe must be >= 1"):
+                EmbeddingIndex(_corpus(64, 8), partitions=8, nprobe=bad)
+
+    @pytest.mark.slow
+    def test_nprobe_above_partitions_clamps_to_full_probe_parity(self):
+        """Over-probing clamps to the partition count — and a full probe
+        IS an exact search (every cell's candidates re-ranked), so the
+        clamp boundary must agree with the exact index: identical
+        neighbor ids, matching distances."""
+        pts = _clustered(512, 16, seed=20)
+        rs = np.random.RandomState(21)
+        qs = pts[rs.choice(512, 16, replace=False)] \
+            + rs.randn(16, 16).astype(np.float32) * 0.2
+        exact = EmbeddingIndex(pts)
+        ivf = EmbeddingIndex(pts, partitions=16, nprobe=99,
+                             kmeans_iters=10, seed=0)
+        assert ivf.stats()["nprobe"] == 16  # clamped at build
+        de, ie = exact.search_batch_arrays(qs, 10)
+        dv, iv = ivf.search_batch_arrays(qs, 10)
+        np.testing.assert_array_equal(ie, iv)
+        np.testing.assert_allclose(de, dv, rtol=1e-4, atol=1e-4)
+        exact.close()
+        ivf.close()
+
+
+# ---------------------------------------------------------------------------
+# HNSW graph store (host-side greedy-descent beam search)
+# ---------------------------------------------------------------------------
+
+class TestHNSW:
+    def test_ctor_validation(self):
+        pts = _corpus(64, 8)
+        with pytest.raises(ValueError, match="store"):
+            EmbeddingIndex(pts, store="float16")
+        with pytest.raises(ValueError, match="hnsw"):
+            EmbeddingIndex(pts, store="hnsw", partitions=8)
+        with pytest.raises(ValueError, match="kmeans"):
+            EmbeddingIndex(pts, kmeans="spherical")
+        with pytest.raises(ValueError, match="sharded"):
+            EmbeddingIndex(pts, partitions=8, kmeans="sharded")
+
+    @pytest.mark.slow
+    def test_recall_gate_and_stats(self):
+        pts = _clustered(2048, 16, seed=22)
+        rs = np.random.RandomState(23)
+        qs = pts[rs.choice(2048, 32, replace=False)] \
+            + rs.randn(32, 16).astype(np.float32) * 0.2
+        # clustered corpora fragment the graph: wider links + deeper
+        # construction beam than the defaults buy the recall margin
+        index = EmbeddingIndex(pts, store="hnsw", hnsw_m=32,
+                               ef_construction=128, ef_search=128)
+        st = index.stats()
+        assert st["variant"] == "hnsw" and st["hnsw_m"] == 32
+        assert st["levels"] >= 1
+        recall = index.measure_recall(qs, k=10)
+        assert recall >= 0.95, f"HNSW recall {recall} below the 0.95 gate"
+        d, idx = index.search_batch_arrays(qs, 5)
+        assert d.shape == (32, 5)
+        assert (idx >= 0).all() and (idx < 2048).all()
+        index.close()
+
+
+# ---------------------------------------------------------------------------
+# sharded k-means training + probe-local IVF residency (8 virtual devices)
+# ---------------------------------------------------------------------------
+
+class TestShardedIVF:
+    @pytest.mark.slow
+    def test_sharded_kmeans_recall_gate(self):
+        """Per-device assign sweeps + all-reduced centroid updates train
+        to the same recall gate as the host loop."""
+        from deeplearning4j_tpu.parallel.mesh import data_mesh
+        pts = _clustered(2048, 16, seed=24)
+        rs = np.random.RandomState(25)
+        qs = pts[rs.choice(2048, 32, replace=False)] \
+            + rs.randn(32, 16).astype(np.float32) * 0.2
+        index = EmbeddingIndex(pts, mesh=data_mesh(8), kmeans="sharded",
+                               partitions=32, nprobe=8, kmeans_iters=10,
+                               seed=0)
+        st = index.stats()
+        assert st["variant"] == "ivf" and st["probe_local"] is True
+        recall = index.measure_recall(qs, k=10)
+        assert recall >= 0.95, f"sharded-kmeans recall {recall} below gate"
+        index.close()
+
+    @pytest.mark.slow
+    def test_probe_local_recall_never_below_global_probe(self):
+        """Per-device residency probes nprobe LOCAL cells per device —
+        the union candidate pool is a superset of the global-probe
+        pool, so recall can only go up (the acceptance property of the
+        probe-local gather)."""
+        from deeplearning4j_tpu.parallel.mesh import data_mesh
+        pts = _clustered(4096, 16, seed=26)
+        rs = np.random.RandomState(27)
+        qs = pts[rs.choice(4096, 32, replace=False)] \
+            + rs.randn(32, 16).astype(np.float32) * 0.2
+        kw = dict(store="int8", partitions=64, nprobe=4,
+                  kmeans_iters=10, seed=0)
+        local = EmbeddingIndex(pts, mesh=data_mesh(8), **kw)
+        globl = EmbeddingIndex(pts, **kw)
+        assert local.stats()["probe_local"] is True
+        assert globl.stats()["probe_local"] is False
+        r_local = local.measure_recall(qs, k=10)
+        r_global = globl.measure_recall(qs, k=10)
+        assert r_local >= r_global, (
+            f"probe-local recall {r_local} fell below global-probe "
+            f"{r_global} — the superset guarantee broke")
+        assert r_local >= 0.9
+        local.close()
+        globl.close()
+
+
+# ---------------------------------------------------------------------------
 # typed failures — never a hang, never a silent loss
 # ---------------------------------------------------------------------------
 

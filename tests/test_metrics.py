@@ -446,6 +446,11 @@ BROKER_KEYS = ["subscribers", "frames_dropped", "subscribers_disconnected",
 SERVER_KEYS = ["retried", "expired", "rejected_circuit", "completed",
                "failed", "accepted", "rejected", "pending", "breaker_state",
                "models"]
+RAG_KEYS = ["submitted", "completed", "failed", "expired", "rejected",
+            "inflight", "k", "page_size", "prefix_hits",
+            "prefix_tokens_reused", "tiers"]
+RAG_TIER_KEYS = ["replicas", "queued", "expired", "completed",
+                 "active_slots", "slots"]
 
 
 class TestLegacyStatsShapes:
@@ -505,6 +510,26 @@ class TestLegacyStatsShapes:
 
         st = KerasBackendServer().stats()
         assert list(st.keys()) == SERVER_KEYS
+
+    @pytest.mark.slow  # builds a two-tier fleet: tier-1 timing headroom
+    def test_rag_pipeline(self, lm):
+        from deeplearning4j_tpu.nearestneighbors.index import EmbeddingIndex
+        from deeplearning4j_tpu.parallel.generation import GenerationServer
+        from deeplearning4j_tpu.parallel.rag import RagPipeline
+
+        vecs = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+        pipe = RagPipeline(
+            lambda rid: EmbeddingIndex(vecs),
+            lambda rid: GenerationServer(lm, 17, slots=2, page_size=4),
+            [np.arange(1, 5, dtype=np.int64)] * 16, page_size=4, k=2)
+        try:
+            st = pipe.stats()
+        finally:
+            pipe.close()
+        assert list(st.keys()) == RAG_KEYS
+        assert list(st["tiers"].keys()) == ["knn", "generate"]
+        for role in ("knn", "generate"):
+            assert list(st["tiers"][role].keys()) == RAG_TIER_KEYS
 
 
 # ---------------------------------------------------------------------------
